@@ -1,0 +1,14 @@
+"""mplc_tpu: a TPU-native multi-partner learning & contributivity framework.
+
+From-scratch JAX/XLA re-design of the capabilities of MPLC
+(multi-partner learning simulation + contributivity measurement,
+reference at /root/reference). See SURVEY.md for the structural map.
+
+Unlike the reference, importing this package has no side effects
+(the reference runs GPU/logging setup on import, mplc/__init__.py:8-9);
+call `mplc_tpu.utils.init_logger()` explicitly if desired.
+"""
+
+from . import constants  # noqa: F401
+
+__version__ = "0.1.0"

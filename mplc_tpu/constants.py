@@ -1,0 +1,60 @@
+"""Framework-wide constants.
+
+Mirrors the parameter surface of the reference simulator
+(/root/reference/mplc/constants.py:1-55) so that configurations written for the
+reference keep their meaning here.
+"""
+
+# ML defaults (reference: mplc/constants.py:7-12)
+DEFAULT_BATCH_SIZE = 256
+MAX_BATCH_SIZE = 2 ** 20
+DEFAULT_GRADIENT_UPDATES_PER_PASS_COUNT = 8
+PATIENCE = 10  # early-stopping patience, in epochs
+DEFAULT_BATCH_COUNT = 20
+DEFAULT_EPOCH_COUNT = 40
+
+# Logging file names (reference: mplc/constants.py:17-18)
+INFO_LOGGING_FILE_NAME = "info.log"
+DEBUG_LOGGING_FILE_NAME = "debug.log"
+
+# Paths
+EXPERIMENTS_FOLDER_NAME = "experiments"
+
+# Quick-demo shrink sizes (reference: mplc/constants.py:24-26)
+TRAIN_SET_MAX_SIZE_QUICK_DEMO = 1000
+VAL_SET_MAX_SIZE_QUICK_DEMO = 500
+TEST_SET_MAX_SIZE_QUICK_DEMO = 500
+
+# Contributivity method registry names (reference: mplc/constants.py:28-43)
+CONTRIBUTIVITY_METHODS = [
+    "Shapley values",
+    "Independent scores",
+    "TMCS",
+    "ITMCS",
+    "IS_lin_S",
+    "IS_reg_S",
+    "AIS_Kriging_S",
+    "SMCS",
+    "WR_SMC",
+    "Federated SBS linear",
+    "Federated SBS quadratic",
+    "Federated SBS constant",
+    "LFlip",
+    "PVRL",
+]
+
+# Dataset tags (reference: mplc/constants.py:46-52)
+MNIST = "mnist"
+CIFAR10 = "cifar10"
+TITANIC = "titanic"
+ESC50 = "esc50"
+IMDB = "imdb"
+SUPPORTED_DATASETS_NAMES = [MNIST, CIFAR10, TITANIC, ESC50, IMDB]
+
+# TPU-specific knobs (new in this framework)
+# Max number of coalitions evaluated in a single compiled batch per device;
+# larger requests are chunked so HBM stays bounded.
+MAX_COALITIONS_PER_DEVICE_BATCH = 16
+# Chunk size (samples) for validation/test-set evaluation inside jit, to bound
+# the [coalitions x partners x samples] activation footprint.
+EVAL_CHUNK_SIZE = 2048

@@ -1,0 +1,10 @@
+from .datasets import Dataset, load_dataset, DATASET_LOADERS, to_categorical
+from .partner import Partner
+from .partition import (StackedPartners, split_basic, split_advanced,
+                        compute_batch_sizes, stack_eval_set)
+
+__all__ = [
+    "Dataset", "load_dataset", "DATASET_LOADERS", "to_categorical", "Partner",
+    "StackedPartners", "split_basic", "split_advanced", "compute_batch_sizes",
+    "stack_eval_set",
+]

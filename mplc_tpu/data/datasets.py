@@ -1,0 +1,339 @@
+"""Datasets: the L1 layer.
+
+Mirrors the reference `Dataset` ABC contract
+(/root/reference/mplc/dataset.py:37-106): attributes `x_train/y_train/
+x_val/y_val/x_test/y_test, input_shape, num_classes`, a global 90/10
+train/val split performed once at construction (random_state=42), and
+overridable local split hooks used by the basic partitioner.
+
+Deviation from the reference, by necessity and by design:
+  - The reference downloads MNIST/CIFAR10/IMDB/ESC50/Titanic from the
+    network (retry loops, /root/reference/mplc/dataset.py:124-142 et al.).
+    This environment has no egress, so each loader first looks for a local
+    cache (`~/.keras/datasets`, or `$MPLC_TPU_DATA_DIR`) and otherwise
+    builds a *deterministic synthetic* dataset with the exact same shapes,
+    class structure and learnability profile (class-prototype + noise).
+    `Dataset.provenance` records which path was taken. MNIST additionally
+    falls back to sklearn's bundled `load_digits` (real handwriting,
+    upsampled 8x8 -> 28x28) as prototype stock.
+  - Arrays are float32 NHWC from the start (the reference reshapes and
+    rescales at download time too).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+from sklearn.model_selection import train_test_split
+
+from .. import constants
+from ..models import zoo as model_zoo
+from ..models.core import Model
+
+
+def to_categorical(y: np.ndarray, num_classes: int) -> np.ndarray:
+    out = np.zeros((len(y), num_classes), np.float32)
+    out[np.arange(len(y)), y.astype(int)] = 1.0
+    return out
+
+
+class Dataset:
+    """Container for one dataset + its model family.
+
+    Matches the reference constructor signature
+    (/root/reference/mplc/dataset.py:37-59) with `model` replacing the
+    Keras `generate_new_model` factory.
+    """
+
+    def __init__(self, dataset_name: str, input_shape: tuple, num_classes: int,
+                 x_train: np.ndarray, y_train: np.ndarray,
+                 x_test: np.ndarray, y_test: np.ndarray,
+                 model: Model | None = None, provenance: str = "user"):
+        self.name = dataset_name
+        self.input_shape = tuple(input_shape)
+        self.num_classes = num_classes
+        self.x_train = x_train
+        self.x_val = None
+        self.x_test = x_test
+        self.y_train = y_train
+        self.y_val = None
+        self.y_test = y_test
+        self.model = model
+        self.provenance = provenance
+        self.train_val_split_global()
+
+    # -- splits (reference: dataset.py:62-77) --------------------------------
+
+    def train_val_split_global(self):
+        if self.x_val is not None or self.y_val is not None:
+            raise Exception("x_val and y_val should be of NoneType")
+        self.x_train, self.x_val, self.y_train, self.y_val = train_test_split(
+            self.x_train, self.y_train, test_size=0.1, random_state=42)
+
+    @staticmethod
+    def train_test_split_local(x, y):
+        return x, np.array([]), y, np.array([])
+
+    @staticmethod
+    def train_val_split_local(x, y):
+        return x, np.array([]), y, np.array([])
+
+    # -- proportion shrink (reference: dataset.py:83-106) --------------------
+
+    def shorten_dataset_proportion(self, dataset_proportion: float):
+        if dataset_proportion == 1:
+            return
+        if not 0 < dataset_proportion < 1:
+            raise ValueError("The dataset proportion should be strictly between 0 and 1")
+        keep_train = int(round(len(self.x_train) * dataset_proportion))
+        keep_val = int(round(len(self.x_val) * dataset_proportion))
+        train_idx = np.arange(len(self.x_train))
+        val_idx = np.arange(len(self.x_val))
+        rng = np.random.RandomState(42)
+        rng.shuffle(train_idx)
+        rng.shuffle(val_idx)
+        self.x_train = self.x_train[train_idx[:keep_train]]
+        self.y_train = self.y_train[train_idx[:keep_train]]
+        self.x_val = self.x_val[val_idx[:keep_val]]
+        self.y_val = self.y_val[val_idx[:keep_val]]
+
+    def generate_new_model(self) -> Model:
+        """Reference-API-compatible alias (`generate_new_model`,
+        /root/reference/mplc/dataset.py:79-81) returning the pure-functional
+        model family instead of a fresh Keras graph (params come from
+        `model.init(rng)`)."""
+        return self.model
+
+
+# ---------------------------------------------------------------------------
+# Offline caches and synthetic generators
+# ---------------------------------------------------------------------------
+
+def _cache_dirs() -> list[Path]:
+    dirs = []
+    env = os.environ.get("MPLC_TPU_DATA_DIR")
+    if env:
+        dirs.append(Path(env))
+    dirs.append(Path.home() / ".keras" / "datasets")
+    return dirs
+
+
+def _find_cache(*names: str) -> Path | None:
+    for d in _cache_dirs():
+        for n in names:
+            p = d / n
+            if p.exists():
+                return p
+    return None
+
+
+def _synth_scale() -> float:
+    return float(os.environ.get("MPLC_TPU_SYNTH_SCALE", "1.0"))
+
+
+def synthetic_image_classification(rng: np.random.Generator, n: int,
+                                   shape: tuple, num_classes: int,
+                                   signal: float = 1.0, noise: float = 0.35
+                                   ) -> tuple[np.ndarray, np.ndarray]:
+    """Class-prototype images + Gaussian noise: learnable by a small CNN to
+    high accuracy, with per-class structure so label corruption genuinely
+    hurts — the property the contributivity oracle tests rely on."""
+    protos = rng.uniform(0.0, 1.0, size=(num_classes,) + tuple(shape)).astype(np.float32)
+    # Smooth prototypes a little so convs have spatial structure to find.
+    if len(shape) == 3:
+        protos = 0.5 * protos + 0.25 * np.roll(protos, 1, axis=1) + 0.25 * np.roll(protos, 1, axis=2)
+    y = rng.integers(0, num_classes, size=n)
+    x = protos[y] * signal + rng.normal(0.0, noise, size=(n,) + tuple(shape)).astype(np.float32)
+    return np.clip(x, 0.0, 1.0).astype(np.float32), y.astype(np.int64)
+
+
+def _digits_prototypes() -> np.ndarray | None:
+    """Real handwritten-digit prototypes from sklearn's bundled digits set,
+    upsampled to 28x28 (no network needed)."""
+    try:
+        from sklearn.datasets import load_digits
+    except Exception:
+        return None
+    d = load_digits()
+    imgs = d.images / 16.0  # [1797, 8, 8]
+    protos = np.zeros((10, 28, 28), np.float32)
+    for c in range(10):
+        mean_img = imgs[d.target == c].mean(axis=0)
+        up = np.kron(mean_img, np.ones((4, 4)))[:28, :28]  # 32x32 -> crop
+        protos[c, 2:30 - 2, 2:30 - 2] = up[:24, :24]
+    return protos
+
+
+# -- per-dataset loaders -----------------------------------------------------
+
+def load_mnist() -> Dataset:
+    cache = _find_cache("mnist.npz")
+    if cache is not None:
+        with np.load(cache, allow_pickle=True) as f:
+            x_train, y_train = f["x_train"], f["y_train"]
+            x_test, y_test = f["x_test"], f["y_test"]
+        x_train = (x_train / 255.0).astype(np.float32).reshape(-1, 28, 28, 1)
+        x_test = (x_test / 255.0).astype(np.float32).reshape(-1, 28, 28, 1)
+        prov = f"cache:{cache}"
+    else:
+        rng = np.random.default_rng(42)
+        n_train = int(60000 * _synth_scale())
+        n_test = int(10000 * _synth_scale())
+        protos = _digits_prototypes()
+        if protos is not None:
+            y_train = rng.integers(0, 10, size=n_train)
+            y_test = rng.integers(0, 10, size=n_test)
+            def make(y):
+                x = protos[y][..., None] + rng.normal(0, 0.25, size=(len(y), 28, 28, 1))
+                return np.clip(x, 0, 1).astype(np.float32)
+            x_train, x_test = make(y_train), make(y_test)
+            prov = "synthetic:sklearn-digits-prototypes"
+        else:
+            x_train, y_train = synthetic_image_classification(rng, n_train, (28, 28, 1), 10)
+            x_test, y_test = synthetic_image_classification(rng, n_test, (28, 28, 1), 10)
+            prov = "synthetic:prototype-noise"
+    return Dataset(constants.MNIST, (28, 28, 1), 10,
+                   x_train, to_categorical(y_train, 10),
+                   x_test, to_categorical(y_test, 10),
+                   model=model_zoo.MNIST_CNN, provenance=prov)
+
+
+def load_cifar10() -> Dataset:
+    cache = _find_cache("cifar10.npz")
+    if cache is not None:
+        with np.load(cache, allow_pickle=True) as f:
+            x_train, y_train = f["x_train"], f["y_train"].reshape(-1)
+            x_test, y_test = f["x_test"], f["y_test"].reshape(-1)
+        x_train = (x_train / 255.0).astype(np.float32)
+        x_test = (x_test / 255.0).astype(np.float32)
+        prov = f"cache:{cache}"
+    else:
+        rng = np.random.default_rng(43)
+        n_train = int(50000 * _synth_scale())
+        n_test = int(10000 * _synth_scale())
+        x_train, y_train = synthetic_image_classification(rng, n_train, (32, 32, 3), 10,
+                                                          signal=0.8, noise=0.45)
+        x_test, y_test = synthetic_image_classification(rng, n_test, (32, 32, 3), 10,
+                                                        signal=0.8, noise=0.45)
+        prov = "synthetic:prototype-noise"
+    return Dataset(constants.CIFAR10, (32, 32, 3), 10,
+                   x_train, to_categorical(y_train, 10),
+                   x_test, to_categorical(y_test, 10),
+                   model=model_zoo.CIFAR10_CNN, provenance=prov)
+
+
+class TitanicDataset(Dataset):
+    """Titanic keeps its local 10% test/val split hooks
+    (/root/reference/mplc/dataset.py:313-321)."""
+
+    @staticmethod
+    def train_test_split_local(x, y):
+        return train_test_split(x, y, test_size=0.1, random_state=42)
+
+    @staticmethod
+    def train_val_split_local(x, y):
+        return train_test_split(x, y, test_size=0.1, random_state=42)
+
+
+def load_titanic() -> Dataset:
+    cache = _find_cache("titanic.npz")
+    if cache is not None:
+        with np.load(cache, allow_pickle=True) as f:
+            x, y = f["x"].astype(np.float32), f["y"].astype(np.float32)
+        prov = f"cache:{cache}"
+    else:
+        # Synthetic 27-feature tabular data with a planted logistic rule
+        # (reference preprocesses the Kaggle CSV into 27 one-hot/numeric
+        # features, input_shape (27,), /root/reference/mplc/dataset.py:214-215).
+        rng = np.random.default_rng(44)
+        n = 891
+        x = rng.normal(0, 1, size=(n, model_zoo.TITANIC_NUM_FEATURES)).astype(np.float32)
+        w = rng.normal(0, 1.5, size=(model_zoo.TITANIC_NUM_FEATURES,))
+        p = 1.0 / (1.0 + np.exp(-(x @ w)))
+        y = (rng.uniform(size=n) < p).astype(np.float32)
+        prov = "synthetic:planted-logistic"
+    x_tr, x_te, y_tr, y_te = train_test_split(x, y, test_size=0.1, random_state=42)
+    return TitanicDataset(constants.TITANIC, (model_zoo.TITANIC_NUM_FEATURES,), 2,
+                          x_tr, y_tr, x_te, y_te,
+                          model=model_zoo.TITANIC_LOGREG, provenance=prov)
+
+
+def load_imdb() -> Dataset:
+    cache = _find_cache("imdb.npz")
+    rng = np.random.default_rng(45)
+    if cache is not None:
+        with np.load(cache, allow_pickle=True) as f:
+            x_train, y_train = f["x_train"], f["y_train"]
+            x_test, y_test = f["x_test"], f["y_test"]
+        # pad/truncate to 500 tokens like keras.preprocessing.sequence
+        def pad(seqs):
+            out = np.zeros((len(seqs), model_zoo.IMDB_SEQ_LEN), np.int32)
+            for i, s in enumerate(seqs):
+                s = np.asarray(s[:model_zoo.IMDB_SEQ_LEN], np.int32)
+                out[i, -len(s):] = s
+            return out
+        x_train, x_test = pad(x_train), pad(x_test)
+        prov = f"cache:{cache}"
+    else:
+        # Synthetic sentiment: each class has a preferred token band; a small
+        # Conv1D+embedding model separates them well above chance.
+        n_train = int(25000 * _synth_scale())
+        n_test = int(25000 * _synth_scale())
+        def make(n):
+            y = rng.integers(0, 2, size=n).astype(np.float32)
+            x = rng.integers(1, model_zoo.IMDB_NUM_WORDS,
+                             size=(n, model_zoo.IMDB_SEQ_LEN)).astype(np.int32)
+            # plant class-marker tokens at random positions
+            marker_count = 40
+            for cls, band in ((0, (100, 200)), (1, (300, 400))):
+                idx = np.where(y == cls)[0]
+                pos = rng.integers(0, model_zoo.IMDB_SEQ_LEN, size=(len(idx), marker_count))
+                tok = rng.integers(band[0], band[1], size=(len(idx), marker_count))
+                x[idx[:, None], pos] = tok
+            return x, y
+        x_train, y_train = make(n_train)
+        x_test, y_test = make(n_test)
+        prov = "synthetic:token-band"
+    return Dataset(constants.IMDB, (model_zoo.IMDB_SEQ_LEN,), 2,
+                   x_train, y_train.astype(np.float32),
+                   x_test, y_test.astype(np.float32),
+                   model=model_zoo.IMDB_CONV1D, provenance=prov)
+
+
+def load_esc50() -> Dataset:
+    cache = _find_cache("esc50.npz")
+    if cache is not None:
+        with np.load(cache, allow_pickle=True) as f:
+            x, y = f["x"].astype(np.float32), f["y"]
+        prov = f"cache:{cache}"
+    else:
+        rng = np.random.default_rng(46)
+        n = int(2000 * max(_synth_scale(), 0.25))
+        x, y = synthetic_image_classification(rng, n, (40, 431, 1), 50,
+                                              signal=1.0, noise=0.30)
+        prov = "synthetic:prototype-noise"
+    x_tr, x_te, y_tr, y_te = train_test_split(x, y, test_size=0.1, random_state=42)
+    return Dataset(constants.ESC50, (40, 431, 1), 50,
+                   x_tr, to_categorical(y_tr, 50),
+                   x_te, to_categorical(y_te, 50),
+                   model=model_zoo.ESC50_CNN, provenance=prov)
+
+
+DATASET_LOADERS = {
+    constants.MNIST: load_mnist,
+    constants.CIFAR10: load_cifar10,
+    constants.TITANIC: load_titanic,
+    constants.ESC50: load_esc50,
+    constants.IMDB: load_imdb,
+}
+
+
+def load_dataset(name: str) -> Dataset:
+    try:
+        return DATASET_LOADERS[name]()
+    except KeyError:
+        raise Exception(
+            f"Dataset named '{name}' is not supported (yet). You can construct "
+            f"your own Dataset object, or add a loader to DATASET_LOADERS.")

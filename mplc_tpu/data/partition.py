@@ -1,0 +1,256 @@
+"""Data partitioning among partners, and the stacked device layout.
+
+Host-side splitting reproduces the reference semantics exactly:
+  - basic random / stratified splits (/root/reference/mplc/scenario.py:571-681),
+    including the seed-42 shuffle and the label-argsort "stratified" option;
+  - the advanced shared/specific cluster split
+    (/root/reference/mplc/scenario.py:392-569);
+  - per-partner batch-size derivation (/root/reference/mplc/scenario.py:705-724).
+
+The TPU-side novelty is `StackedPartners`: instead of the reference's
+per-partner Python lists of arrays, all partners' train data is padded to a
+common length and stacked on a leading axis `[P, Nmax, ...]` with a validity
+mask. That single layout choice is what makes every multi-partner strategy a
+`vmap`/`scan` over axis 0 and every coalition a length-P mask — no ragged
+shapes ever reach XLA.
+"""
+
+from __future__ import annotations
+
+import random as _pyrandom
+from typing import NamedTuple, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+from sklearn.model_selection import train_test_split
+from sklearn.preprocessing import LabelEncoder
+
+from .datasets import Dataset
+from .partner import Partner
+
+
+# ---------------------------------------------------------------------------
+# Basic split (reference scenario.py:571-681)
+# ---------------------------------------------------------------------------
+
+def split_basic(dataset: Dataset, partners_list: Sequence[Partner],
+                amounts_per_partner: Sequence[float], description: str,
+                minibatch_count: int) -> None:
+    partners_count = len(partners_list)
+    y_train_enc = LabelEncoder().fit_transform([str(y) for y in dataset.y_train])
+
+    assert len(amounts_per_partner) == partners_count, (
+        "Error: amounts_per_partner list should have a size equal to partners_count")
+    assert abs(np.sum(amounts_per_partner) - 1.0) < 1e-9, (
+        "Error: the sum of the amounts_per_partner proportions isn't equal to 1")
+
+    if partners_count == 1:
+        train_idx_list = [np.arange(len(y_train_enc))]
+    else:
+        cum = np.cumsum(amounts_per_partner)[:-1]
+        splitting_indices_train = (cum * len(y_train_enc)).astype(int)
+        if description == "stratified":
+            train_idx = np.asarray(y_train_enc).argsort()
+        elif description == "random":
+            train_idx = np.arange(len(y_train_enc))
+            np.random.RandomState(42).shuffle(train_idx)
+        else:
+            raise NameError(f"This samples_split option [{description}] is not recognized.")
+        train_idx_list = np.split(train_idx, splitting_indices_train)
+
+    for p, idx in zip(partners_list, train_idx_list):
+        p.x_train = np.asarray(dataset.x_train)[idx]
+        p.y_train = np.asarray(dataset.y_train)[idx]
+        p.x_train, p.x_test, p.y_train, p.y_test = dataset.train_test_split_local(
+            p.x_train, p.y_train)
+        p.x_train, p.x_val, p.y_train, p.y_val = dataset.train_val_split_local(
+            p.x_train, p.y_train)
+        p.final_nb_samples = len(p.x_train)
+        p.clusters_list = sorted(set(np.asarray(y_train_enc)[idx].tolist()))
+
+    assert minibatch_count <= min(amounts_per_partner) * len(y_train_enc), (
+        "Error: a partner doesn't have enough data samples to create the minibatches")
+
+
+# ---------------------------------------------------------------------------
+# Advanced split (reference scenario.py:392-569)
+# ---------------------------------------------------------------------------
+
+def split_advanced(dataset: Dataset, partners_list: Sequence[Partner],
+                   amounts_per_partner: Sequence[float],
+                   description: Sequence, minibatch_count: int) -> tuple[int, list[float]]:
+    """Cluster-per-label split with 'shared'/'specific' cluster assignment.
+
+    Returns (nb_samples_used, final_relative_nb_samples)."""
+    y_train = LabelEncoder().fit_transform([str(y) for y in dataset.y_train])
+    x_full = np.asarray(dataset.x_train)
+    y_full = np.asarray(dataset.y_train)
+
+    for p in partners_list:
+        p.cluster_count = int(description[p.id][0])
+        p.cluster_split_option = description[p.id][1]
+    shared_ps = [p for p in partners_list if p.cluster_split_option == "shared"]
+    specific_ps = [p for p in partners_list if p.cluster_split_option == "specific"]
+    shared_ps.sort(key=lambda p: p.cluster_count, reverse=True)
+    specific_ps.sort(key=lambda p: p.cluster_count, reverse=True)
+
+    labels = sorted(set(y_train.tolist()))
+    rnd = _pyrandom.Random(42)
+    rnd.shuffle(labels)
+
+    nb_diff_labels = len(labels)
+    specific_clusters_count = sum(p.cluster_count for p in specific_ps)
+    shared_clusters_count = max((p.cluster_count for p in shared_ps), default=0)
+    assert specific_clusters_count + shared_clusters_count <= nb_diff_labels, (
+        "Incompatibility between the advanced split arguments and the dataset's "
+        "label count: total requested clusters exceed the number of labels")
+
+    x_c, y_c, n_c = {}, {}, {}
+    for label in labels:
+        idx = np.where(y_train == label)[0]
+        x_c[label] = x_full[idx]
+        y_c[label] = y_full[idx]
+        n_c[label] = len(idx)
+
+    index = 0
+    for p in specific_ps:
+        p.clusters_list = labels[index:index + p.cluster_count]
+        index += p.cluster_count
+    shared_clusters = labels[index:index + shared_clusters_count]
+    for p in shared_ps:
+        p.clusters_list = rnd.sample(shared_clusters, k=p.cluster_count)
+
+    resize_specific = 1.0
+    for p in specific_ps:
+        available = sum(n_c[cl] for cl in p.clusters_list)
+        requested = int(amounts_per_partner[p.id] * len(y_train))
+        resize_specific = min(resize_specific, available / requested)
+
+    resize_shared = 1.0
+    needed = dict.fromkeys(shared_clusters, 0)
+    for p in shared_ps:
+        amount = int(amounts_per_partner[p.id] * len(y_train) * resize_specific)
+        per_cluster = int(amount / p.cluster_count)
+        for cl in p.clusters_list:
+            needed[cl] += per_cluster
+    for cl in needed:
+        if needed[cl] > 0:
+            resize_shared = min(resize_shared, n_c[cl] / needed[cl])
+
+    final_resize = resize_specific * resize_shared
+    for p in partners_list:
+        p.final_nb_samples = int(amounts_per_partner[p.id] * len(y_train) * final_resize)
+        p.final_nb_samples_p_cluster = int(p.final_nb_samples / p.cluster_count)
+    nb_samples_used = sum(p.final_nb_samples for p in partners_list)
+    final_relative = [p.final_nb_samples / nb_samples_used for p in partners_list]
+
+    shared_index = dict.fromkeys(shared_clusters, 0)
+    for p in partners_list:
+        xs, ys = [], []
+        if p in shared_ps:
+            for cl in p.clusters_list:
+                i0 = shared_index[cl]
+                xs.append(x_c[cl][i0:i0 + p.final_nb_samples_p_cluster])
+                ys.append(y_c[cl][i0:i0 + p.final_nb_samples_p_cluster])
+                shared_index[cl] += p.final_nb_samples_p_cluster
+        else:
+            for cl in p.clusters_list:
+                xs.append(x_c[cl][:p.final_nb_samples_p_cluster])
+                ys.append(y_c[cl][:p.final_nb_samples_p_cluster])
+        p.x_train = np.concatenate(xs)
+        p.y_train = np.concatenate(ys)
+        p.x_train, p.x_val, p.y_train, p.y_val = train_test_split(
+            p.x_train, p.y_train, test_size=0.1, random_state=42)
+        p.x_train, p.x_test, p.y_train, p.y_test = train_test_split(
+            p.x_train, p.y_train, test_size=0.1, random_state=42)
+
+    assert minibatch_count <= min(len(p.x_train) for p in partners_list), (
+        "Error: a partner doesn't have enough data samples to create the minibatches")
+    return nb_samples_used, final_relative
+
+
+# ---------------------------------------------------------------------------
+# Batch sizes (reference scenario.py:705-724)
+# ---------------------------------------------------------------------------
+
+def compute_batch_sizes(partners_list: Sequence[Partner], minibatch_count: int,
+                        gradient_updates_per_pass_count: int,
+                        max_batch_size: int) -> None:
+    if len(partners_list) == 1:
+        p = partners_list[0]
+        p.batch_size = int(np.clip(len(p.x_train) // gradient_updates_per_pass_count,
+                                   1, max_batch_size))
+    else:
+        for p in partners_list:
+            bs = len(p.x_train) // (minibatch_count * gradient_updates_per_pass_count)
+            p.batch_size = int(np.clip(bs, 1, max_batch_size))
+
+
+# ---------------------------------------------------------------------------
+# Stacked device layout
+# ---------------------------------------------------------------------------
+
+class StackedPartners(NamedTuple):
+    """All partners' train data as padded stacked device tensors (a pytree).
+
+    x:     [P, Nmax, ...]   float32 (or int32 tokens)
+    y:     [P, Nmax, L]     float32 (one-hot, or [.,1] binary)
+    mask:  [P, Nmax]        float32 validity
+    sizes: [P]              int32 true sample counts
+    """
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    mask: jnp.ndarray
+    sizes: jnp.ndarray
+
+    @property
+    def partners_count(self) -> int:
+        return int(self.x.shape[0])
+
+    @property
+    def n_max(self) -> int:
+        return int(self.x.shape[1])
+
+    @staticmethod
+    def build(partners_list: Sequence[Partner], label_dim: int) -> "StackedPartners":
+        P = len(partners_list)
+        n_max = max(len(p.x_train) for p in partners_list)
+        x0 = np.asarray(partners_list[0].x_train)
+        x_dtype = np.int32 if np.issubdtype(x0.dtype, np.integer) else np.float32
+        x = np.zeros((P, n_max) + x0.shape[1:], x_dtype)
+        y = np.zeros((P, n_max, label_dim), np.float32)
+        mask = np.zeros((P, n_max), np.float32)
+        sizes = np.zeros((P,), np.int32)
+        for i, p in enumerate(partners_list):
+            n = len(p.x_train)
+            x[i, :n] = p.x_train
+            yi = np.asarray(p.y_train, np.float32)
+            if yi.ndim == 1:
+                yi = yi[:, None]
+            y[i, :n] = yi
+            mask[i, :n] = 1.0
+            sizes[i] = n
+        return StackedPartners(jnp.asarray(x), jnp.asarray(y),
+                               jnp.asarray(mask), jnp.asarray(sizes))
+
+
+def stack_eval_set(x: np.ndarray, y: np.ndarray, label_dim: int,
+                   chunk: int) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pad an eval set to a multiple of `chunk` and reshape to
+    [n_chunks, chunk, ...] so in-jit evaluation is a `lax.scan` over chunks
+    (bounded activation memory even when vmapped over partners x coalitions)."""
+    n = len(x)
+    n_pad = (-n) % chunk
+    x = np.asarray(x)
+    y = np.asarray(y, np.float32)
+    if y.ndim == 1:
+        y = y[:, None]
+    x_dtype = np.int32 if np.issubdtype(x.dtype, np.integer) else np.float32
+    xp = np.concatenate([x, np.zeros((n_pad,) + x.shape[1:], x.dtype)]).astype(x_dtype)
+    yp = np.concatenate([y, np.zeros((n_pad, y.shape[1]), np.float32)])
+    mask = np.concatenate([np.ones(n, np.float32), np.zeros(n_pad, np.float32)])
+    n_chunks = (n + n_pad) // chunk
+    return (jnp.asarray(xp.reshape((n_chunks, chunk) + x.shape[1:])),
+            jnp.asarray(yp.reshape(n_chunks, chunk, y.shape[1])),
+            jnp.asarray(mask.reshape(n_chunks, chunk)))

@@ -1,0 +1,114 @@
+"""Partner: one data-providing silo, plus its label-corruption operators.
+
+Mirrors the reference `Partner` (/root/reference/mplc/partner.py:14-124)
+including the four corruption families (offset "corrupt", permutation,
+Dirichlet "random", per-row shuffle) and their semantics on one-hot or
+integer labels. Corruption is the reference's *data-plane fault injector*:
+contributivity methods are validated by their ability to down-rank corrupted
+partners, so these transforms are first-class here too.
+
+Design change: all randomness is drawn from an explicit `numpy` Generator
+(default seeded per partner) instead of the global `random`/`np.random`
+state, so scenarios are reproducible end-to-end.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import constants
+from .datasets import to_categorical
+
+
+def _ensure_categorical(y: np.ndarray) -> tuple[np.ndarray, bool]:
+    """Reference `_Decorator.categorical_needed`
+    (/root/reference/mplc/partner.py:37-55): promote 1-D integer labels to
+    one-hot for the transform, remember to demote after."""
+    if y.ndim == 1:
+        return to_categorical(y.astype(int), int(y.max()) + 1 if len(y) else 2), True
+    return y, False
+
+
+class Partner:
+    def __init__(self, partner_id: int, seed: int | None = None):
+        self.id = partner_id
+        self.batch_size = constants.DEFAULT_BATCH_SIZE
+
+        self.cluster_count: int = 0
+        self.cluster_split_option: str = ""
+        self.clusters_list: list = []
+        self.final_nb_samples: int = 0
+        self.final_nb_samples_p_cluster: int = 0
+
+        self.x_train = None
+        self.x_val = None
+        self.x_test = None
+        self.y_train = None
+        self.y_val = None
+        self.y_test = None
+
+        self.corruption_matrix = None
+        self._rng = np.random.default_rng(0xC0A1 + partner_id if seed is None else seed)
+
+    @property
+    def num_labels(self) -> int:
+        return self.y_train.shape[1]
+
+    @property
+    def data_volume(self) -> int:
+        return len(self.y_train)
+
+    def _check_proportion(self, proportion: float):
+        if not 0 <= proportion <= 1:
+            raise ValueError(
+                f"The proportion of labels to corrupt was {proportion} "
+                f"but it must be between 0 and 1.")
+
+    def corrupt_labels(self, proportion_corrupted: float):
+        """Offset corruption: argmax label c -> c-1 (reference partner.py:62-79)."""
+        self._check_proportion(proportion_corrupted)
+        y, demote = _ensure_categorical(self.y_train)
+        n = int(len(y) * proportion_corrupted)
+        idx = self._rng.choice(len(y), size=n, replace=False)
+        hot = np.argmax(y[idx], axis=1)
+        y[idx] = 0.0
+        y[idx, hot - 1] = 1.0
+        self.y_train = np.argmax(y, axis=1) if demote else y
+
+    def permute_labels(self, proportion_corrupted: float = 1):
+        """Apply a random K x K permutation matrix (reference partner.py:81-96)."""
+        self._check_proportion(proportion_corrupted)
+        y, demote = _ensure_categorical(self.y_train)
+        n = int(len(y) * proportion_corrupted)
+        idx = self._rng.choice(len(y), size=n, replace=False)
+        k = y.shape[1]
+        self.corruption_matrix = np.zeros((k, k))
+        self.corruption_matrix[np.arange(k), self._rng.permutation(k)] = 1
+        y[idx] = y[idx] @ self.corruption_matrix.T
+        self.y_train = np.argmax(y, axis=1) if demote else y
+
+    def random_labels(self, proportion_corrupted: float = 1):
+        """Resample labels from a per-class Dirichlet row (reference partner.py:98-113)."""
+        self._check_proportion(proportion_corrupted)
+        y, demote = _ensure_categorical(self.y_train)
+        n = int(len(y) * proportion_corrupted)
+        idx = self._rng.choice(len(y), size=n, replace=False)
+        k = y.shape[1]
+        self.corruption_matrix = self._rng.dirichlet(np.ones(k), k)
+        rows = self.corruption_matrix[np.argmax(y[idx], axis=1)]
+        # vectorized categorical draw per row via inverse-CDF
+        u = self._rng.uniform(size=(n, 1))
+        draw = (u < np.cumsum(rows, axis=1)).argmax(axis=1)
+        y[idx] = 0.0
+        y[idx, draw] = 1.0
+        self.y_train = np.argmax(y, axis=1) if demote else y
+
+    def shuffle_labels(self, proportion_shuffled: float):
+        """Shuffle each selected row's one-hot vector (reference partner.py:116-124)."""
+        self._check_proportion(proportion_shuffled)
+        y, demote = _ensure_categorical(self.y_train)
+        n = int(len(y) * proportion_shuffled)
+        idx = self._rng.choice(len(y), size=n, replace=False)
+        for i in idx:
+            self._rng.shuffle(y[i])
+        self.y_train = np.argmax(y, axis=1) if demote else y

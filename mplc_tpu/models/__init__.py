@@ -1,0 +1,8 @@
+from .core import Model, adam_like_keras, rmsprop_like_keras
+from .zoo import (MODELS, MNIST_CNN, CIFAR10_CNN, IMDB_CONV1D, ESC50_CNN,
+                  TITANIC_LOGREG)
+
+__all__ = [
+    "Model", "adam_like_keras", "rmsprop_like_keras", "MODELS",
+    "MNIST_CNN", "CIFAR10_CNN", "IMDB_CONV1D", "ESC50_CNN", "TITANIC_LOGREG",
+]

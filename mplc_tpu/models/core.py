@@ -1,0 +1,57 @@
+"""The Model contract: a bundle of pure functions.
+
+The reference couples models to datasets via Keras factories
+(`Dataset.generate_new_model`, /root/reference/mplc/dataset.py:79-81). The
+TPU-native equivalent is a frozen bundle of pure functions: `init` builds a
+parameter pytree, `apply` maps (params, batch) -> logits. Because params are
+plain pytrees, a fleet of per-partner or per-coalition model replicas is just
+the same pytree with a stacked leading axis — `vmap` does the rest, and
+weight "communication" is a masked reduction over that axis
+(see mplc_tpu/ops/aggregation.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    """A pure-functional model family.
+
+    Attributes:
+        name: model family tag.
+        init: rng -> params pytree (float32 leaves).
+        apply: (params, x, train, rng, compute_dtype) -> logits (float32).
+        loss_kind: "categorical" (softmax CE over one-hot labels) or
+            "binary" (sigmoid CE over a single logit).
+        num_outputs: logits dimensionality (1 for binary).
+        make_optimizer: () -> optax.GradientTransformation.
+    """
+
+    name: str
+    init: Callable[[jax.Array], dict]
+    apply: Callable[..., jax.Array]
+    loss_kind: str
+    num_outputs: int
+    make_optimizer: Callable[[], optax.GradientTransformation]
+
+    def label_dim(self) -> int:
+        """Width of the label array fed to the loss (one-hot width, or 1)."""
+        return 1 if self.loss_kind == "binary" else self.num_outputs
+
+
+def adam_like_keras(learning_rate: float = 1e-3) -> optax.GradientTransformation:
+    # Keras Adam defaults use eps=1e-7 (vs optax 1e-8); matched for parity.
+    return optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-7)
+
+
+def rmsprop_like_keras(learning_rate: float = 1e-4) -> optax.GradientTransformation:
+    # Reference CIFAR10 CNN compiles RMSprop(lr=1e-4, decay=1e-6)
+    # (/root/reference/mplc/dataset.py:192-196). Keras "decay" is a lr schedule;
+    # at the step counts involved its effect is negligible, so plain rmsprop.
+    return optax.rmsprop(learning_rate, decay=0.9, eps=1e-7)

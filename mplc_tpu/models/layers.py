@@ -1,0 +1,120 @@
+"""Minimal pure-functional NN layers (init + apply) for the model zoo.
+
+Deliberately not a port of Keras: layers are plain functions over explicit
+parameter pytrees, so whole models can be stacked along a leading partner
+axis and driven by `vmap`/`scan`/`shard_map`. Initializers match Keras
+defaults (glorot-uniform kernels, zero biases, uniform(-0.05, 0.05)
+embeddings) so training dynamics stay comparable to the reference models
+(/root/reference/mplc/dataset.py:167-200, :457-479, :546-567, :695-722).
+
+Convolutions use NHWC layout and run through `lax.conv_general_dilated`,
+which XLA tiles onto the TPU MXU; pooling uses `lax.reduce_window`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _glorot_uniform(rng: jax.Array, shape: tuple[int, ...], fan_in: int, fan_out: int) -> jax.Array:
+    limit = np.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, jnp.float32, -limit, limit)
+
+
+# ---------------------------------------------------------------------------
+# Dense
+# ---------------------------------------------------------------------------
+
+def dense_init(rng: jax.Array, in_dim: int, out_dim: int) -> dict:
+    return {
+        "w": _glorot_uniform(rng, (in_dim, out_dim), in_dim, out_dim),
+        "b": jnp.zeros((out_dim,), jnp.float32),
+    }
+
+
+def dense(params: dict, x: jax.Array) -> jax.Array:
+    return x @ params["w"] + params["b"]
+
+
+# ---------------------------------------------------------------------------
+# Conv2D (NHWC), Conv1D (NWC)
+# ---------------------------------------------------------------------------
+
+def conv2d_init(rng: jax.Array, kh: int, kw: int, cin: int, cout: int) -> dict:
+    fan_in = kh * kw * cin
+    fan_out = kh * kw * cout
+    return {
+        "w": _glorot_uniform(rng, (kh, kw, cin, cout), fan_in, fan_out),
+        "b": jnp.zeros((cout,), jnp.float32),
+    }
+
+
+def conv2d(params: dict, x: jax.Array, padding: str = "VALID") -> jax.Array:
+    out = lax.conv_general_dilated(
+        x, params["w"], window_strides=(1, 1), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return out + params["b"]
+
+
+def conv1d_init(rng: jax.Array, k: int, cin: int, cout: int) -> dict:
+    fan_in = k * cin
+    fan_out = k * cout
+    return {
+        "w": _glorot_uniform(rng, (k, cin, cout), fan_in, fan_out),
+        "b": jnp.zeros((cout,), jnp.float32),
+    }
+
+
+def conv1d(params: dict, x: jax.Array, padding: str = "SAME") -> jax.Array:
+    out = lax.conv_general_dilated(
+        x, params["w"], window_strides=(1,), padding=padding,
+        dimension_numbers=("NWC", "WIO", "NWC"))
+    return out + params["b"]
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+def max_pool_2d(x: jax.Array, window: int = 2) -> jax.Array:
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, window, window, 1),
+        padding="VALID")
+
+
+def max_pool_1d(x: jax.Array, window: int = 2) -> jax.Array:
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, window, 1),
+        window_strides=(1, window, 1),
+        padding="VALID")
+
+
+def global_avg_pool_2d(x: jax.Array) -> jax.Array:
+    return jnp.mean(x, axis=(1, 2))
+
+
+# ---------------------------------------------------------------------------
+# Embedding / dropout
+# ---------------------------------------------------------------------------
+
+def embedding_init(rng: jax.Array, vocab: int, dim: int) -> dict:
+    return {"table": jax.random.uniform(rng, (vocab, dim), jnp.float32, -0.05, 0.05)}
+
+
+def embedding(params: dict, tokens: jax.Array) -> jax.Array:
+    return jnp.take(params["table"], tokens.astype(jnp.int32), axis=0)
+
+
+def dropout(rng: jax.Array, x: jax.Array, rate: float, train: bool) -> jax.Array:
+    if not train or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)

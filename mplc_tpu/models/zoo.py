@@ -1,0 +1,195 @@
+"""The five built-in model families, re-implemented TPU-first.
+
+Architecture parity targets (reference, Keras):
+  - MNIST CNN:   /root/reference/mplc/dataset.py:457-479
+  - CIFAR10 CNN: /root/reference/mplc/dataset.py:167-200
+  - IMDB Embedding+Conv1D: /root/reference/mplc/dataset.py:546-567
+  - ESC50 CNN:   /root/reference/mplc/dataset.py:695-722
+  - Titanic logistic regression (sklearn shim in the reference,
+    /root/reference/mplc/dataset.py:302-394): here a 1-layer sigmoid model
+    trained by SGD like every other family, keeping the metric contract
+    (log-loss + accuracy) without the sklearn detour.
+
+All `apply` functions take `compute_dtype` so activations/matmuls can run in
+bfloat16 on the MXU while parameters and logits stay float32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+from .core import Model, adam_like_keras, rmsprop_like_keras
+
+
+def _split(rng, n):
+    return jax.random.split(rng, n)
+
+
+def _cast(tree, dtype):
+    return jax.tree_util.tree_map(lambda t: t.astype(dtype), tree)
+
+
+# ---------------------------------------------------------------------------
+# MNIST CNN: conv3x3x32 -> conv3x3x64 -> maxpool2 -> dense128 -> dense10
+# ---------------------------------------------------------------------------
+
+def _mnist_init(rng: jax.Array) -> dict:
+    r1, r2, r3, r4 = _split(rng, 4)
+    return {
+        "c1": L.conv2d_init(r1, 3, 3, 1, 32),
+        "c2": L.conv2d_init(r2, 3, 3, 32, 64),
+        "d1": L.dense_init(r3, 12 * 12 * 64, 128),
+        "d2": L.dense_init(r4, 128, 10),
+    }
+
+
+def _mnist_apply(params, x, train=False, rng=None, compute_dtype=jnp.float32):
+    p = _cast(params, compute_dtype)
+    h = x.astype(compute_dtype)
+    h = jax.nn.relu(L.conv2d(p["c1"], h))
+    h = jax.nn.relu(L.conv2d(p["c2"], h))
+    h = L.max_pool_2d(h)
+    h = h.reshape(h.shape[0], -1)
+    h = jax.nn.relu(L.dense(p["d1"], h))
+    return L.dense(p["d2"], h).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# CIFAR10 CNN: [conv32 same, conv32, pool, drop.25] x2 (64), dense512, drop.5
+# ---------------------------------------------------------------------------
+
+def _cifar_init(rng: jax.Array) -> dict:
+    r1, r2, r3, r4, r5, r6 = _split(rng, 6)
+    return {
+        "c1": L.conv2d_init(r1, 3, 3, 3, 32),
+        "c2": L.conv2d_init(r2, 3, 3, 32, 32),
+        "c3": L.conv2d_init(r3, 3, 3, 32, 64),
+        "c4": L.conv2d_init(r4, 3, 3, 64, 64),
+        "d1": L.dense_init(r5, 6 * 6 * 64, 512),
+        "d2": L.dense_init(r6, 512, 10),
+    }
+
+
+def _cifar_apply(params, x, train=False, rng=None, compute_dtype=jnp.float32):
+    p = _cast(params, compute_dtype)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    k1, k2, k3 = _split(rng, 3)
+    h = x.astype(compute_dtype)
+    h = jax.nn.relu(L.conv2d(p["c1"], h, padding="SAME"))
+    h = jax.nn.relu(L.conv2d(p["c2"], h))
+    h = L.max_pool_2d(h)
+    h = L.dropout(k1, h, 0.25, train)
+    h = jax.nn.relu(L.conv2d(p["c3"], h, padding="SAME"))
+    h = jax.nn.relu(L.conv2d(p["c4"], h))
+    h = L.max_pool_2d(h)
+    h = L.dropout(k2, h, 0.25, train)
+    h = h.reshape(h.shape[0], -1)
+    h = jax.nn.relu(L.dense(p["d1"], h))
+    h = L.dropout(k3, h, 0.5, train)
+    return L.dense(p["d2"], h).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# IMDB: embed(5000,32) -> conv1d(32,k3,same) -> maxpool -> dense256 -> dense64 -> 1
+# ---------------------------------------------------------------------------
+
+IMDB_NUM_WORDS = 5000
+IMDB_SEQ_LEN = 500
+
+
+def _imdb_init(rng: jax.Array) -> dict:
+    r1, r2, r3, r4, r5 = _split(rng, 5)
+    return {
+        "emb": L.embedding_init(r1, IMDB_NUM_WORDS, 32),
+        "c1": L.conv1d_init(r2, 3, 32, 32),
+        "d1": L.dense_init(r3, (IMDB_SEQ_LEN // 2) * 32, 256),
+        "d2": L.dense_init(r4, 256, 64),
+        "d3": L.dense_init(r5, 64, 1),
+    }
+
+
+def _imdb_apply(params, x, train=False, rng=None, compute_dtype=jnp.float32):
+    p = _cast(params, compute_dtype)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    k1, k2 = _split(rng, 2)
+    h = L.embedding(p["emb"], x)
+    h = jax.nn.relu(L.conv1d(p["c1"], h, padding="SAME"))
+    h = L.max_pool_1d(h)
+    h = h.reshape(h.shape[0], -1)
+    h = jax.nn.relu(L.dense(p["d1"], h))
+    h = L.dropout(k1, h, 0.5, train)
+    h = jax.nn.relu(L.dense(p["d2"], h))
+    h = L.dropout(k2, h, 0.5, train)
+    return L.dense(p["d3"], h).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# ESC50: 4x [conv k2, pool2, drop .2] (16/32/64/128) -> GAP -> dense50
+# ---------------------------------------------------------------------------
+
+def _esc50_init(rng: jax.Array) -> dict:
+    r1, r2, r3, r4, r5 = _split(rng, 5)
+    return {
+        "c1": L.conv2d_init(r1, 2, 2, 1, 16),
+        "c2": L.conv2d_init(r2, 2, 2, 16, 32),
+        "c3": L.conv2d_init(r3, 2, 2, 32, 64),
+        "c4": L.conv2d_init(r4, 2, 2, 64, 128),
+        "d1": L.dense_init(r5, 128, 50),
+    }
+
+
+def _esc50_apply(params, x, train=False, rng=None, compute_dtype=jnp.float32):
+    p = _cast(params, compute_dtype)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    ks = _split(rng, 4)
+    h = x.astype(compute_dtype)
+    for i, name in enumerate(["c1", "c2", "c3", "c4"]):
+        h = jax.nn.relu(L.conv2d(p[name], h))
+        h = L.max_pool_2d(h)
+        h = L.dropout(ks[i], h, 0.2, train)
+    h = L.global_avg_pool_2d(h)
+    return L.dense(p["d1"], h).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Titanic: logistic regression over 27 features
+# ---------------------------------------------------------------------------
+
+TITANIC_NUM_FEATURES = 27
+
+
+def _titanic_init(rng: jax.Array) -> dict:
+    return {"d1": L.dense_init(rng, TITANIC_NUM_FEATURES, 1)}
+
+
+def _titanic_apply(params, x, train=False, rng=None, compute_dtype=jnp.float32):
+    p = _cast(params, compute_dtype)
+    return L.dense(p["d1"], x.astype(compute_dtype)).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+MNIST_CNN = Model("mnist_cnn", _mnist_init, _mnist_apply, "categorical", 10, adam_like_keras)
+CIFAR10_CNN = Model("cifar10_cnn", _cifar_init, _cifar_apply, "categorical", 10,
+                    partial(rmsprop_like_keras, 1e-4))
+IMDB_CONV1D = Model("imdb_conv1d", _imdb_init, _imdb_apply, "binary", 1, adam_like_keras)
+ESC50_CNN = Model("esc50_cnn", _esc50_init, _esc50_apply, "categorical", 50, adam_like_keras)
+TITANIC_LOGREG = Model("titanic_logreg", _titanic_init, _titanic_apply, "binary", 1,
+                       partial(adam_like_keras, 1e-2))
+
+MODELS = {
+    "mnist_cnn": MNIST_CNN,
+    "cifar10_cnn": CIFAR10_CNN,
+    "imdb_conv1d": IMDB_CONV1D,
+    "esc50_cnn": ESC50_CNN,
+    "titanic_logreg": TITANIC_LOGREG,
+}

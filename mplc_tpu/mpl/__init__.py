@@ -1,0 +1,15 @@
+from .engine import MplTrainer, TrainConfig, TrainState, EvalSet, APPROACH_NAMES
+from .history import History
+from .approaches import (MULTI_PARTNER_LEARNING_APPROACHES, MultiPartnerLearning,
+                         FederatedAverageLearning, SequentialLearning,
+                         SequentialWithFinalAggLearning, SequentialAverageLearning,
+                         MplLabelFlip, SinglePartnerLearning, save_params_npz,
+                         load_params_npz)
+
+__all__ = [
+    "MplTrainer", "TrainConfig", "TrainState", "EvalSet", "APPROACH_NAMES",
+    "History", "MULTI_PARTNER_LEARNING_APPROACHES", "MultiPartnerLearning",
+    "FederatedAverageLearning", "SequentialLearning",
+    "SequentialWithFinalAggLearning", "SequentialAverageLearning",
+    "MplLabelFlip", "SinglePartnerLearning", "save_params_npz", "load_params_npz",
+]

@@ -1,0 +1,240 @@
+"""Host-side multi-partner learning classes: the reference L4 API surface.
+
+`MULTI_PARTNER_LEARNING_APPROACHES` keeps the reference registry keys
+(/root/reference/mplc/multi_partner_learning.py:521-527) and each class keeps
+the `Cls(scenario, **kwargs).fit()` contract with the same kwargs whitelist
+(:21-30). The classes are thin: all training happens in the compiled
+`MplTrainer` (mplc_tpu/mpl/engine.py); `fit()` is the epoch-chunk driver
+plus History/book-keeping.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import constants
+from ..data.partition import StackedPartners, stack_eval_set
+from .engine import EvalSet, MplTrainer, TrainConfig
+from .history import History
+
+ALLOWED_PARAMETERS = ("partners_list",
+                      "epoch_count",
+                      "minibatch_count",
+                      "dataset",
+                      "aggregation_method",
+                      "is_early_stopping",
+                      "is_save_data",
+                      "save_folder",
+                      "init_model_from",
+                      "use_saved_weights")
+
+
+def _eval_chunk_size(n: int) -> int:
+    return int(min(constants.EVAL_CHUNK_SIZE, max(128, 1 << (max(n - 1, 1)).bit_length())))
+
+
+def save_params_npz(path: Path, params) -> None:
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    np.savez(path, treedef=np.array(str(treedef)),
+             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+
+
+def load_params_npz(path, like_params):
+    with np.load(str(path), allow_pickle=True) as f:
+        leaves = [jnp.asarray(f[f"leaf_{i}"]) for i in range(len(f.files) - 1)]
+    treedef = jax.tree_util.tree_structure(like_params)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class MultiPartnerLearning:
+    """Base class: owns data staging, the compiled trainer and `fit()`."""
+
+    approach_key = "fedavg"
+
+    def __init__(self, scenario, **kwargs):
+        self.dataset = scenario.dataset
+        self.partners_list = scenario.partners_list
+        self.init_model_from = scenario.init_model_from
+        self.use_saved_weights = scenario.use_saved_weights
+
+        self.epoch_count = scenario.epoch_count
+        self.minibatch_count = scenario.minibatch_count
+        self.gradient_updates_per_pass_count = scenario.gradient_updates_per_pass_count
+        self.is_early_stopping = scenario.is_early_stopping
+        self.aggregation_method = scenario.aggregation_name
+
+        self.is_save_data = False
+        self.save_folder = getattr(scenario, "save_folder", None)
+        self.compute_dtype = getattr(scenario, "compute_dtype", "float32")
+        self.seed = getattr(scenario, "seed", 0)
+
+        self.__dict__.update((k, v) for k, v in kwargs.items() if k in ALLOWED_PARAMETERS)
+
+        self.partners_list = sorted(self.partners_list, key=lambda p: p.id)
+        self.val_data = (self.dataset.x_val, self.dataset.y_val)
+        self.test_data = (self.dataset.x_test, self.dataset.y_test)
+        self.dataset_name = self.dataset.name
+        self.model = self.dataset.model
+
+        self.epoch_index = 0
+        self.minibatch_index = 0
+        self.learning_computation_time = 0.0
+
+        self.cfg = TrainConfig(
+            approach=self.approach_key,
+            aggregator=self.aggregation_method,
+            epoch_count=self.epoch_count,
+            minibatch_count=self.minibatch_count,
+            gradient_updates_per_pass=self.gradient_updates_per_pass_count,
+            is_early_stopping=self.is_early_stopping,
+            compute_dtype=self.compute_dtype,
+        )
+        self.trainer = MplTrainer(self.model, self.cfg)
+        self.history = History([p.id for p in self.partners_list],
+                               self.epoch_count, self.minibatch_count,
+                               save_folder=self.save_folder)
+        self.model_params = None
+        self._state = None
+
+    @property
+    def partners_count(self) -> int:
+        return len(self.partners_list)
+
+    # -- data staging ---------------------------------------------------
+
+    def _stage(self):
+        label_dim = self.model.label_dim()
+        stacked = StackedPartners.build(self.partners_list, label_dim)
+        val = EvalSet(*stack_eval_set(self.val_data[0], self.val_data[1], label_dim,
+                                      _eval_chunk_size(len(self.val_data[0]))))
+        test = EvalSet(*stack_eval_set(self.test_data[0], self.test_data[1], label_dim,
+                                       _eval_chunk_size(len(self.test_data[0]))))
+        return stacked, val, test
+
+    def _init_params(self, rng):
+        if self.use_saved_weights:
+            template = self.model.init(rng)
+            return load_params_npz(self.init_model_from, template)
+        return None
+
+    # -- the fit driver -------------------------------------------------
+
+    def fit(self):
+        start = time.perf_counter()
+        stacked, val, test = self._stage()
+        rng = jax.random.PRNGKey(self.seed)
+        state = self.trainer.init_state(rng, self.partners_count,
+                                        init_params=self._init_params(rng))
+        coal_mask = jnp.ones((self.partners_count,), jnp.float32)
+
+        chunk = self.cfg.patience if self.cfg.is_early_stopping else self.cfg.epoch_count
+        chunk = max(1, min(chunk, self.cfg.epoch_count))
+        run = jax.jit(self.trainer.epoch_chunk, static_argnames=("n_epochs",))
+        epochs_left = self.cfg.epoch_count
+        while epochs_left > 0:
+            n = min(chunk, epochs_left)
+            state = run(state, stacked, val, coal_mask, rng, n_epochs=n)
+            epochs_left -= n
+            if bool(jax.device_get(state.done)):
+                break
+
+        test_loss, test_acc = jax.jit(self.trainer.finalize)(state, test)
+        self._state = state
+        self.model_params = state.params
+        self.epoch_index = int(jax.device_get(state.epoch))
+        self.history.fill_from_state(
+            [p.id for p in self.partners_list],
+            state.val_loss_h, state.val_acc_h, state.partner_h,
+            int(jax.device_get(state.nb_epochs_done)), float(test_acc))
+        if self.approach_key == "lflip" and state.theta.size:
+            theta = np.asarray(state.theta)
+            self.history.theta = [[theta[i] for i in range(self.partners_count)]
+                                  for _ in range(max(self.epoch_index, 1))]
+        if self.is_save_data:
+            self.save_final_model()
+            self.history.save_data()
+        self.learning_computation_time = time.perf_counter() - start
+        return self.history.score
+
+    # -- misc reference-API methods -------------------------------------
+
+    def save_final_model(self):
+        if self.save_folder is None or self.model_params is None:
+            return
+        model_folder = Path(self.save_folder) / "model"
+        model_folder.mkdir(parents=True, exist_ok=True)
+        save_params_npz(model_folder / f"{self.dataset_name}_final_weights.npz",
+                        self.model_params)
+
+    def eval_and_log_final_model__test_perf(self):
+        return self.history.score
+
+
+class FederatedAverageLearning(MultiPartnerLearning):
+    approach_key = "fedavg"
+
+    def __init__(self, scenario, **kwargs):
+        super().__init__(scenario, **kwargs)
+        if self.partners_count == 1:
+            raise ValueError("Only one partner is provided. Please use the "
+                             "dedicated SinglePartnerLearning class")
+
+
+class SequentialLearning(MultiPartnerLearning):
+    approach_key = "seq-pure"
+
+    def __init__(self, scenario, **kwargs):
+        super().__init__(scenario, **kwargs)
+        if self.partners_count == 1:
+            raise ValueError("Only one partner is provided. Please use the "
+                             "dedicated SinglePartnerLearning class")
+
+
+class SequentialWithFinalAggLearning(SequentialLearning):
+    approach_key = "seq-with-final-agg"
+
+
+class SequentialAverageLearning(SequentialLearning):
+    approach_key = "seqavg"
+
+
+class MplLabelFlip(MultiPartnerLearning):
+    approach_key = "lflip"
+
+    def __init__(self, scenario, epsilon: float = 0.01, **kwargs):
+        super().__init__(scenario, **kwargs)
+        if self.model.loss_kind != "categorical":
+            raise ValueError("LFlip requires a categorical model")
+        self.epsilon = epsilon
+        import dataclasses
+        self.cfg = dataclasses.replace(self.cfg, lflip_epsilon=epsilon)
+        self.trainer = MplTrainer(self.model, self.cfg)
+
+
+class SinglePartnerLearning(MultiPartnerLearning):
+    approach_key = "single"
+
+    def __init__(self, scenario, partner=None, **kwargs):
+        if partner is not None:
+            if isinstance(partner, (list, np.ndarray)):
+                raise ValueError("More than one partner is provided")
+            kwargs["partners_list"] = [partner]
+        super().__init__(scenario, **kwargs)
+        if self.partners_count != 1:
+            raise ValueError("SinglePartnerLearning requires exactly one partner")
+        self.partner = self.partners_list[0]
+
+
+MULTI_PARTNER_LEARNING_APPROACHES = {
+    "fedavg": FederatedAverageLearning,
+    "seq-pure": SequentialLearning,
+    "seq-with-final-agg": SequentialWithFinalAggLearning,
+    "seqavg": SequentialAverageLearning,
+    "lflip": MplLabelFlip,
+}

@@ -1,0 +1,556 @@
+"""The multi-partner training engine: one compiled, coalition-maskable trainer.
+
+This replaces the reference's L4 layer (/root/reference/mplc/
+multi_partner_learning.py) — where "multi-partner training" is a Python
+for-loop of serialized Keras `.fit()` calls — with a single functional
+program designed for XLA:
+
+  - Partners are a stacked leading axis: per-partner local training is a
+    `vmap` (fedavg/lflip) or a `lax.scan` over a permuted order (seq-*).
+  - A coalition is a length-P 0/1 mask. The mask multiplies every per-sample
+    loss mask (so inactive partners produce exactly-zero gradients and
+    therefore exactly-zero optimizer updates) and gates the aggregation
+    weight vector. Because of this, the WHOLE trainer is vmappable over a
+    batch of coalition masks — the key to evaluating 2^N Shapley coalitions
+    in parallel (SURVEY.md §2.2).
+  - Training runs in *epoch chunks*: a jitted `lax.scan` over up to
+    `patience` epochs, driven by a tiny host loop that stops as soon as
+    every coalition in the batch has early-stopped. This keeps data-dependent
+    stopping out of the compiled graph while wasting at most one chunk of
+    extra epochs.
+  - All data selection is static-shape: each partner's epoch permutation
+    lives in a padded [P, Nmax] index array; minibatch i / gradient-step g
+    slices are `dynamic_slice`s with validity masks, reproducing the
+    reference's minibatch semantics (partner.py:155-167) without ragged
+    shapes.
+
+Reference loop semantics reproduced deliberately:
+  - A fresh optimizer per partner-pass (the reference builds and compiles a
+    new Keras model every `fit_minibatch`, multi_partner_learning.py:319).
+  - Global-model validation is logged at the *start* of every minibatch
+    (multi_partner_learning.py:314).
+  - Early stopping compares val_loss at [e, col] vs [e-PATIENCE, col] where
+    col is 0 for fedavg-family and MB-1 for seq-family — the reference's
+    minibatch_index reset quirk (multi_partner_learning.py:299 vs seq).
+  - `single` (1-partner) training keeps a persistent optimizer across epochs
+    and uses Keras-style "no improvement for PATIENCE epochs" early stopping
+    (multi_partner_learning.py:247-260).
+
+Known deviations (documented in DESIGN_NOTES.md): minibatch remainders
+(n_p mod minibatch_count samples per epoch) are dropped to keep shapes
+static; the reference's np.split keeps them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from .. import constants
+from ..models.core import Model
+from ..ops.aggregation import aggregate, aggregation_weights, broadcast
+from ..ops.metrics import masked_loss_and_metrics
+
+APPROACH_NAMES = ("fedavg", "seq-pure", "seq-with-final-agg", "seqavg", "lflip", "single")
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    approach: str = "fedavg"
+    aggregator: str = "uniform"
+    epoch_count: int = constants.DEFAULT_EPOCH_COUNT
+    minibatch_count: int = constants.DEFAULT_BATCH_COUNT
+    gradient_updates_per_pass: int = constants.DEFAULT_GRADIENT_UPDATES_PER_PASS_COUNT
+    is_early_stopping: bool = True
+    patience: int = constants.PATIENCE
+    compute_dtype: str = "float32"
+    record_partner_val: bool = True
+    lflip_epsilon: float = 0.01
+
+    def __post_init__(self):
+        if self.approach not in APPROACH_NAMES:
+            raise KeyError(
+                f"Multi-partner learning approach '{self.approach}' is not a valid "
+                f"approach. List of supported approaches: {', '.join(APPROACH_NAMES)}")
+
+    @property
+    def dtype(self):
+        return jnp.bfloat16 if self.compute_dtype == "bfloat16" else jnp.float32
+
+
+class TrainState(NamedTuple):
+    """Carried across epoch chunks. Every leaf is per-coalition when the
+    trainer is vmapped (leading batch axis added by vmap)."""
+    params: Any              # global model params pytree
+    opt_state: Any           # persistent optimizer state ('single' only; else empty)
+    theta: jax.Array         # [P, K, K] label-flip matrices (lflip only; else [0])
+    epoch: jax.Array         # i32 scalar: next epoch index
+    done: jax.Array          # bool scalar: early-stopped
+    nb_epochs_done: jax.Array  # i32 scalar
+    best_val_loss: jax.Array   # f32 scalar ('single' ES)
+    es_wait: jax.Array         # i32 scalar ('single' ES)
+    val_loss_h: jax.Array    # [E, MB] global val loss history
+    val_acc_h: jax.Array     # [E, MB]
+    partner_h: jax.Array     # [4, P, E, MB]: loss, acc, val_loss, val_acc
+
+
+class EvalSet(NamedTuple):
+    x: jax.Array   # [n_chunks, chunk, ...]
+    y: jax.Array   # [n_chunks, chunk, L]
+    mask: jax.Array  # [n_chunks, chunk]
+
+
+def tree_where(cond, a, b):
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.where(jnp.reshape(cond, (1,) * x.ndim), x, y), a, b)
+
+
+class MplTrainer:
+    """Compiled trainer for one (model, config, data-shape) combination.
+
+    Methods are pure and vmap/shard_map-friendly; `init_state` and
+    `epoch_chunk` are the primitives, `finalize` evaluates the test score.
+    Host-side orchestration (epoch-chunk loop, coalition batching) lives in
+    mplc_tpu.mpl.approaches and mplc_tpu.contrib.engine.
+    """
+
+    def __init__(self, model: Model, cfg: TrainConfig):
+        self.model = model
+        self.cfg = cfg
+        self.opt = model.make_optimizer()
+        self.label_dim = model.label_dim()
+
+    # ------------------------------------------------------------------
+    # state init
+    # ------------------------------------------------------------------
+
+    def init_state(self, rng: jax.Array, partners_count: int,
+                   init_params=None) -> TrainState:
+        cfg = self.cfg
+        params = self.model.init(rng) if init_params is None else init_params
+        if cfg.approach == "single":
+            opt_state = self.opt.init(params)
+        else:
+            opt_state = ()
+        if cfg.approach == "lflip":
+            k = self.model.num_outputs
+            eye = jnp.eye(k)
+            theta0 = eye * (1 - cfg.lflip_epsilon) + (1 - eye) * (cfg.lflip_epsilon / (k - 1))
+            theta = jnp.broadcast_to(theta0, (partners_count, k, k))
+        else:
+            theta = jnp.zeros((0,))
+        E, MB = cfg.epoch_count, cfg.minibatch_count
+        return TrainState(
+            params=params, opt_state=opt_state, theta=theta,
+            epoch=jnp.zeros((), jnp.int32), done=jnp.zeros((), bool),
+            nb_epochs_done=jnp.zeros((), jnp.int32),
+            best_val_loss=jnp.full((), jnp.inf, jnp.float32),
+            es_wait=jnp.zeros((), jnp.int32),
+            val_loss_h=jnp.full((E, MB), jnp.nan, jnp.float32),
+            val_acc_h=jnp.full((E, MB), jnp.nan, jnp.float32),
+            partner_h=jnp.full((4, partners_count, E, MB), jnp.nan, jnp.float32),
+        )
+
+    # ------------------------------------------------------------------
+    # evaluation (chunked scan: bounded memory under vmap)
+    # ------------------------------------------------------------------
+
+    def evaluate(self, params, ev: EvalSet) -> tuple[jax.Array, jax.Array]:
+        """(mean_loss, accuracy) over a chunked eval set."""
+        loss_kind = self.model.loss_kind
+        dtype = self.cfg.dtype
+
+        def body(carry, chunk):
+            ls, cs, cnt = carry
+            cx, cy, cm = chunk
+            logits = self.model.apply(params, cx, train=False, compute_dtype=dtype)
+            l, a, c = masked_loss_and_metrics(loss_kind, logits, cy, cm)
+            return (ls + l * c, cs + a * c, cnt + c), None
+
+        (ls, cs, cnt), _ = lax.scan(body, (0.0, 0.0, 0.0), (ev.x, ev.y, ev.mask))
+        denom = jnp.maximum(cnt, 1.0)
+        return ls / denom, cs / denom
+
+    # ------------------------------------------------------------------
+    # data selection helpers (all static shapes)
+    # ------------------------------------------------------------------
+
+    def _epoch_perms(self, rng, mask_pn):
+        """Per-partner random permutation of real rows: [P, Nmax] indices with
+        all valid rows first, in random order."""
+        keys = jax.random.uniform(rng, mask_pn.shape) + (1.0 - mask_pn) * 1e9
+        return jnp.argsort(keys, axis=1).astype(jnp.int32)
+
+    def _subbatch(self, perm_p, size_p, mb_i, g, sb_cap):
+        """Indices + mask for gradient step g of minibatch mb_i of one partner."""
+        mbc, gup = self.cfg.minibatch_count, self.cfg.gradient_updates_per_pass
+        valid_mb = size_p // mbc                      # samples per minibatch
+        sb = (valid_mb + gup - 1) // gup              # samples per step
+        ar = jnp.arange(sb_cap, dtype=jnp.int32)
+        local = g * sb + ar
+        valid = (ar < sb) & (local < valid_mb)
+        pos = mb_i * valid_mb + local
+        idx = perm_p[jnp.clip(pos, 0, perm_p.shape[0] - 1)]
+        return idx, valid.astype(jnp.float32)
+
+    def _minibatch_window(self, perm_p, size_p, mb_i, mb_cap):
+        """Indices + mask for the whole minibatch mb_i of one partner."""
+        mbc = self.cfg.minibatch_count
+        valid_mb = size_p // mbc
+        ar = jnp.arange(mb_cap, dtype=jnp.int32)
+        valid = ar < valid_mb
+        pos = mb_i * valid_mb + ar
+        idx = perm_p[jnp.clip(pos, 0, perm_p.shape[0] - 1)]
+        return idx, valid.astype(jnp.float32)
+
+    # ------------------------------------------------------------------
+    # gradient step
+    # ------------------------------------------------------------------
+
+    def _loss_fn(self, params, x, y, m, rng):
+        logits = self.model.apply(params, x, train=True, rng=rng,
+                                  compute_dtype=self.cfg.dtype)
+        loss, acc, cnt = masked_loss_and_metrics(self.model.loss_kind, logits, y, m)
+        return loss, (acc, cnt)
+
+    def _sgd_step(self, params, opt_state, x, y, m, rng):
+        (loss, (acc, cnt)), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+            params, x, y, m, rng)
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, acc, cnt
+
+    # ------------------------------------------------------------------
+    # one partner's local pass over its minibatch (fresh optimizer)
+    # ------------------------------------------------------------------
+
+    def _partner_pass(self, start_params, x_p, y_p, perm_p, size_p, active_p,
+                      mb_i, rng_p, opt_state=None, y_override=None,
+                      window_idx=None):
+        """Run `gup` masked SGD steps for one partner on minibatch mb_i.
+
+        If `y_override`/`window_idx` are given (lflip), steps slice rows from
+        that pre-gathered minibatch window instead of the raw arrays.
+        Returns (params, opt_state, pass_loss, pass_acc).
+        """
+        cfg = self.cfg
+        n_max = x_p.shape[0]
+        mb_cap = max(n_max // cfg.minibatch_count, 1)
+        sb_cap = (mb_cap + cfg.gradient_updates_per_pass - 1) // cfg.gradient_updates_per_pass
+        fresh = opt_state is None
+        if fresh:
+            opt_state = self.opt.init(start_params)
+
+        def step(carry, g):
+            params, opt_state, sums = carry
+            idx, valid = self._subbatch(perm_p, size_p, mb_i, g, sb_cap)
+            if y_override is not None:
+                # rows within the pre-flipped minibatch window
+                mbc, gup = cfg.minibatch_count, cfg.gradient_updates_per_pass
+                valid_mb = size_p // mbc
+                sb = (valid_mb + gup - 1) // gup
+                ar = jnp.arange(sb_cap, dtype=jnp.int32)
+                local = jnp.clip(g * sb + ar, 0, y_override.shape[0] - 1)
+                x = jnp.take(x_p, jnp.take(window_idx, local, axis=0), axis=0)
+                y = jnp.take(y_override, local, axis=0)
+            else:
+                x = jnp.take(x_p, idx, axis=0)
+                y = jnp.take(y_p, idx, axis=0)
+            m = valid * active_p
+            step_rng = jax.random.fold_in(rng_p, g)
+            params, opt_state, loss, acc, cnt = self._sgd_step(
+                params, opt_state, x, y, m, step_rng)
+            sums = (sums[0] + loss * cnt, sums[1] + acc * cnt, sums[2] + cnt)
+            return (params, opt_state, sums), None
+
+        (params, opt_state, sums), _ = lax.scan(
+            step, (start_params, opt_state, (0.0, 0.0, 0.0)),
+            jnp.arange(cfg.gradient_updates_per_pass))
+        denom = jnp.maximum(sums[2], 1.0)
+        return params, opt_state, sums[0] / denom, sums[1] / denom
+
+    # ------------------------------------------------------------------
+    # lflip: EM update of theta + label resampling for one partner minibatch
+    # ------------------------------------------------------------------
+
+    def _lflip_flip(self, params, theta_p, x_p, y_p, perm_p, size_p, mb_i,
+                    mb_cap, rng):
+        """Reference MplLabelFlip.fit_minibatch EM scheme
+        (multi_partner_learning.py:452-516), vectorized and masked."""
+        idx, valid = self._minibatch_window(perm_p, size_p, mb_i, mb_cap)
+        x = jnp.take(x_p, idx, axis=0)
+        y = jnp.take(y_p, idx, axis=0)                       # [M, K] one-hot
+        logits = self.model.apply(params, x, train=False, compute_dtype=self.cfg.dtype)
+        preds = jax.nn.softmax(logits, axis=-1)              # [M, K]
+        vm = valid[:, None]
+
+        def posterior(theta):
+            # theta_[i, :] = preds[i, :] * theta[:, argmax(y_i)], then l1-normalize columns
+            t = preds * (y @ theta.T) * vm                   # rows for labels' columns
+            col = jnp.maximum(jnp.sum(jnp.abs(t), axis=0, keepdims=True), 1e-12)
+            return t / col
+
+        theta_post = posterior(theta_p)
+        new_theta = theta_post.T @ y                         # [K, K]
+        row = jnp.maximum(jnp.sum(jnp.abs(new_theta), axis=1, keepdims=True), 1e-12)
+        new_theta = new_theta / row
+        theta_post = posterior(new_theta)
+
+        # Draw flipped labels from each row's categorical distribution.
+        cdf = jnp.cumsum(theta_post, axis=1)
+        u = jax.random.uniform(rng, (theta_post.shape[0], 1)) * jnp.maximum(
+            cdf[:, -1:], 1e-12)
+        draw = jnp.argmax(u <= cdf, axis=1)
+        y_flip = jax.nn.one_hot(draw, y.shape[1], dtype=jnp.float32)
+        return new_theta, y_flip, idx, valid
+
+    # ------------------------------------------------------------------
+    # epoch bodies
+    # ------------------------------------------------------------------
+
+    def _record_partner(self, partner_h, e, mb_i, metrics):
+        """metrics: [4, P] (loss, acc, val_loss, val_acc) for this round."""
+        return partner_h.at[:, :, e, mb_i].set(metrics)
+
+    def _fedavg_epoch(self, state: TrainState, stacked, val: EvalSet,
+                      coal_mask, rng) -> TrainState:
+        cfg = self.cfg
+        P = stacked.x.shape[0]
+        e = state.epoch
+        perms = self._epoch_perms(jax.random.fold_in(rng, 0), stacked.mask)
+        lflip = cfg.approach == "lflip"
+        n_max = stacked.x.shape[1]
+        mb_cap = max(n_max // cfg.minibatch_count, 1)
+
+        def mb_body(carry, mb_i):
+            params, theta, vl_h, va_h, p_h = carry
+            vl, va = self.evaluate(params, val)
+            vl_h = vl_h.at[e, mb_i].set(vl)
+            va_h = va_h.at[e, mb_i].set(va)
+
+            rng_mb = jax.random.fold_in(jax.random.fold_in(rng, 1), mb_i)
+            p_rngs = jax.random.split(rng_mb, P)
+
+            if lflip:
+                def one(theta_p, x_p, y_p, perm_p, size_p, act, r):
+                    new_theta, y_flip, w_idx, _ = self._lflip_flip(
+                        params, theta_p, x_p, y_p, perm_p, size_p, mb_i, mb_cap, r)
+                    new_theta = jnp.where(act > 0, new_theta, theta_p)
+                    p, _, ls, ac = self._partner_pass(
+                        params, x_p, y_p, perm_p, size_p, act, mb_i,
+                        jax.random.fold_in(r, 7), y_override=y_flip, window_idx=w_idx)
+                    return p, new_theta, ls, ac
+                new_params, theta, losses, accs = jax.vmap(one)(
+                    theta, stacked.x, stacked.y, perms, stacked.sizes, coal_mask, p_rngs)
+            else:
+                def one(x_p, y_p, perm_p, size_p, act, r):
+                    p, _, ls, ac = self._partner_pass(
+                        params, x_p, y_p, perm_p, size_p, act, mb_i, r)
+                    return p, ls, ac
+                new_params, losses, accs = jax.vmap(one)(
+                    stacked.x, stacked.y, perms, stacked.sizes, coal_mask, p_rngs)
+
+            need_pval = cfg.record_partner_val or cfg.aggregator == "local-score"
+            if need_pval:
+                pvl, pva = jax.vmap(lambda pp: self.evaluate(pp, val))(new_params)
+            else:
+                pvl = jnp.full((P,), jnp.nan)
+                pva = jnp.full((P,), jnp.nan)
+            p_h = self._record_partner(p_h, e, mb_i,
+                                       jnp.stack([losses, accs, pvl, pva]))
+
+            w = aggregation_weights(cfg.aggregator, coal_mask,
+                                    stacked.sizes, jnp.nan_to_num(pva))
+            params = aggregate(new_params, w)
+            return (params, theta, vl_h, va_h, p_h), None
+
+        (params, theta, vl_h, va_h, p_h), _ = lax.scan(
+            mb_body, (state.params, state.theta, state.val_loss_h,
+                      state.val_acc_h, state.partner_h),
+            jnp.arange(cfg.minibatch_count))
+        return state._replace(params=params, theta=theta, val_loss_h=vl_h,
+                              val_acc_h=va_h, partner_h=p_h)
+
+    def _seq_epoch(self, state: TrainState, stacked, val: EvalSet,
+                   coal_mask, rng) -> TrainState:
+        cfg = self.cfg
+        P = stacked.x.shape[0]
+        e = state.epoch
+        perms = self._epoch_perms(jax.random.fold_in(rng, 0), stacked.mask)
+        partner_stack = broadcast(state.params, P)
+
+        def mb_body(carry, mb_i):
+            params, partner_stack, vl_h, va_h, p_h = carry
+            vl, va = self.evaluate(params, val)
+            vl_h = vl_h.at[e, mb_i].set(vl)
+            va_h = va_h.at[e, mb_i].set(va)
+
+            rng_mb = jax.random.fold_in(jax.random.fold_in(rng, 1), mb_i)
+            # Random visit order with active partners first
+            order_keys = jax.random.uniform(jax.random.fold_in(rng_mb, 0), (P,)) \
+                + (1.0 - coal_mask) * 1e3
+            order = jnp.argsort(order_keys).astype(jnp.int32)
+            opt_state0 = self.opt.init(params)
+
+            def partner_body(carry2, pos):
+                params, opt_state, partner_stack, p_h = carry2
+                p = order[pos]
+                act = coal_mask[p]
+                x_p = jnp.take(stacked.x, p, axis=0)
+                y_p = jnp.take(stacked.y, p, axis=0)
+                perm_p = jnp.take(perms, p, axis=0)
+                size_p = jnp.take(stacked.sizes, p, axis=0)
+                r = jax.random.fold_in(rng_mb, pos + 1)
+                new_params, new_opt, ls, ac = self._partner_pass(
+                    params, x_p, y_p, perm_p, size_p, act, mb_i, r,
+                    opt_state=opt_state)
+                params = tree_where(act > 0, new_params, params)
+                opt_state = tree_where(act > 0, new_opt, opt_state)
+                partner_stack = jax.tree_util.tree_map(
+                    lambda leaf, newp: leaf.at[p].set(
+                        jnp.where(act > 0, newp, leaf[p])),
+                    partner_stack, params)
+                if cfg.record_partner_val or cfg.aggregator == "local-score":
+                    pvl, pva = self.evaluate(params, val)
+                else:
+                    pvl, pva = jnp.nan, jnp.nan
+                vals = jnp.where(act > 0,
+                                 jnp.stack([ls, ac, pvl, pva]),
+                                 p_h[:, p, e, mb_i])
+                p_h = p_h.at[:, p, e, mb_i].set(vals)
+                return (params, opt_state, partner_stack, p_h), None
+
+            (params, _, partner_stack, p_h), _ = lax.scan(
+                partner_body, (params, opt_state0, partner_stack, p_h),
+                jnp.arange(P))
+
+            if cfg.approach == "seqavg":
+                w = aggregation_weights(cfg.aggregator, coal_mask, stacked.sizes,
+                                        jnp.nan_to_num(p_h[3, :, e, mb_i]))
+                params = aggregate(partner_stack, w)
+            return (params, partner_stack, vl_h, va_h, p_h), None
+
+        (params, partner_stack, vl_h, va_h, p_h), _ = lax.scan(
+            mb_body, (state.params, partner_stack, state.val_loss_h,
+                      state.val_acc_h, state.partner_h),
+            jnp.arange(cfg.minibatch_count))
+
+        if cfg.approach == "seq-with-final-agg":
+            w = aggregation_weights(cfg.aggregator, coal_mask, stacked.sizes,
+                                    jnp.nan_to_num(p_h[3, :, e, cfg.minibatch_count - 1]))
+            params = aggregate(partner_stack, w)
+        return state._replace(params=params, val_loss_h=vl_h, val_acc_h=va_h,
+                              partner_h=p_h)
+
+    def _single_epoch(self, state: TrainState, stacked, val: EvalSet,
+                      coal_mask, rng) -> TrainState:
+        """One epoch of single-partner training: `mb*gup` persistent-optimizer
+        steps over the lone active partner's shuffled data
+        (reference SinglePartnerLearning, multi_partner_learning.py:230-275)."""
+        cfg = self.cfg
+        e = state.epoch
+        # the lone active partner's row
+        p = jnp.argmax(coal_mask).astype(jnp.int32)
+        x_p = jnp.take(stacked.x, p, axis=0)
+        y_p = jnp.take(stacked.y, p, axis=0)
+        size_p = jnp.take(stacked.sizes, p, axis=0)
+        mask_p = jnp.take(stacked.mask, p, axis=0)
+        n_max = x_p.shape[0]
+        keys = jax.random.uniform(jax.random.fold_in(rng, 0), (n_max,)) \
+            + (1.0 - mask_p) * 1e9
+        perm = jnp.argsort(keys).astype(jnp.int32)
+        steps = cfg.minibatch_count * cfg.gradient_updates_per_pass
+        sb_cap = max((n_max + steps - 1) // steps, 1)
+        sb = (size_p + steps - 1) // steps
+
+        def step(carry, g):
+            params, opt_state, sums = carry
+            ar = jnp.arange(sb_cap, dtype=jnp.int32)
+            local = g * sb + ar
+            valid = ((ar < sb) & (local < size_p)).astype(jnp.float32)
+            idx = perm[jnp.clip(local, 0, n_max - 1)]
+            x = jnp.take(x_p, idx, axis=0)
+            y = jnp.take(y_p, idx, axis=0)
+            params, opt_state, loss, acc, cnt = self._sgd_step(
+                params, opt_state, x, y, valid, jax.random.fold_in(rng, g + 1))
+            sums = (sums[0] + loss * cnt, sums[1] + acc * cnt, sums[2] + cnt)
+            return (params, opt_state, sums), None
+
+        (params, opt_state, sums), _ = lax.scan(
+            step, (state.params, state.opt_state, (0.0, 0.0, 0.0)),
+            jnp.arange(steps))
+        vl, va = self.evaluate(params, val)
+        denom = jnp.maximum(sums[2], 1.0)
+        vl_h = state.val_loss_h.at[e, 0].set(vl)
+        va_h = state.val_acc_h.at[e, 0].set(va)
+        p_h = state.partner_h
+        p_h = p_h.at[:, 0, e, 0].set(jnp.stack([sums[0] / denom, sums[1] / denom, vl, va]))
+        return state._replace(params=params, opt_state=opt_state,
+                              val_loss_h=vl_h, val_acc_h=va_h, partner_h=p_h)
+
+    # ------------------------------------------------------------------
+    # epoch + early stopping + chunk driver
+    # ------------------------------------------------------------------
+
+    def _early_stop_flag(self, state: TrainState) -> jax.Array:
+        cfg = self.cfg
+        e = state.epoch
+        if not cfg.is_early_stopping:
+            return jnp.zeros((), bool)
+        if cfg.approach == "single":
+            # Keras EarlyStopping semantics handled in run_epoch via best/wait.
+            return state.es_wait >= cfg.patience
+        col = 0 if cfg.approach in ("fedavg", "lflip") else cfg.minibatch_count - 1
+        cur = state.val_loss_h[e, col]
+        past = state.val_loss_h[jnp.maximum(e - cfg.patience, 0), col]
+        return (e >= cfg.patience) & (cur > past)
+
+    def run_epoch(self, state: TrainState, stacked, val: EvalSet,
+                  coal_mask, rng) -> TrainState:
+        """One epoch with done-freezing; safe inside scan/vmap."""
+        cfg = self.cfg
+        rng = jax.random.fold_in(rng, state.epoch)
+        if cfg.approach in ("fedavg", "lflip"):
+            new = self._fedavg_epoch(state, stacked, val, coal_mask, rng)
+        elif cfg.approach == "single":
+            new = self._single_epoch(state, stacked, val, coal_mask, rng)
+        else:
+            new = self._seq_epoch(state, stacked, val, coal_mask, rng)
+
+        # single-partner Keras-style ES bookkeeping
+        if cfg.approach == "single":
+            vl = new.val_loss_h[new.epoch, 0]
+            improved = vl < new.best_val_loss
+            new = new._replace(
+                best_val_loss=jnp.where(improved, vl, new.best_val_loss),
+                es_wait=jnp.where(improved, 0, new.es_wait + 1))
+
+        stop = self._early_stop_flag(new)
+        advanced = new._replace(
+            epoch=new.epoch + 1,
+            nb_epochs_done=new.nb_epochs_done + 1,
+            done=new.done | stop | (new.epoch + 1 >= cfg.epoch_count))
+        # freeze everything if this coalition had already stopped
+        return tree_where(state.done, state, advanced)
+
+    def epoch_chunk(self, state: TrainState, stacked, val: EvalSet,
+                    coal_mask, rng, n_epochs: int) -> TrainState:
+        def body(s, i):
+            return self.run_epoch(s, stacked, val, coal_mask,
+                                  jax.random.fold_in(rng, i)), None
+        state, _ = lax.scan(body, state, jnp.arange(n_epochs))
+        return state
+
+    def finalize(self, state: TrainState, test: EvalSet) -> tuple[jax.Array, jax.Array]:
+        """(test_loss, test_accuracy) of the final global model — the
+        characteristic-function value (reference history.score,
+        multi_partner_learning.py:158-169)."""
+        return self.evaluate(state.params, test)

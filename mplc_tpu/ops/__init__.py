@@ -1,0 +1,9 @@
+from .aggregation import aggregation_weights, aggregate, broadcast, AGGREGATOR_NAMES
+from .metrics import (masked_loss_and_metrics, softmax_cross_entropy,
+                      sigmoid_binary_cross_entropy)
+
+__all__ = [
+    "aggregation_weights", "aggregate", "broadcast", "AGGREGATOR_NAMES",
+    "masked_loss_and_metrics", "softmax_cross_entropy",
+    "sigmoid_binary_cross_entropy",
+]

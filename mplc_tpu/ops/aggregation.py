@@ -1,0 +1,68 @@
+"""Weight aggregation: the "communication backend".
+
+In the reference, inter-partner communication is literally a layer-by-layer
+`np.average` over Python lists of weights (/root/reference/mplc/
+mpl_utils.py:90-102) with three weighting policies (:105-128). Here partner
+models are one pytree with a stacked leading axis `[P, ...]`, so aggregation
+is a single fused einsum per leaf — and when partners are sharded over a
+device mesh axis, the same code lowers to a `psum`-style reduction over ICI
+via `shard_map` (see mplc_tpu/parallel/).
+
+Coalition membership composes in at this exact point: the coalition bitmask
+multiplies the weight vector before normalization, which is what makes a
+characteristic-function evaluation "training with a masked reduction" and
+therefore vmappable over all 2^N masks at once.
+
+The reference's "local-score" policy forgets its `return` and is broken
+upstream (mpl_utils.py:126-128, noted in SURVEY.md §7); implemented
+correctly here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+AGGREGATOR_NAMES = ("uniform", "data-volume", "local-score")
+
+
+def aggregation_weights(kind: str, coalition_mask: jax.Array,
+                        sizes: jax.Array, last_scores: jax.Array) -> jax.Array:
+    """Build the normalized weight vector w[P] for one aggregation step.
+
+    kind: 'uniform' | 'data-volume' | 'local-score'
+    coalition_mask: [P] float 0/1 — inactive partners get weight 0.
+    sizes: [P] sample counts (data-volume policy).
+    last_scores: [P] last-round val accuracy (local-score policy).
+    """
+    if kind == "uniform":
+        raw = coalition_mask
+    elif kind == "data-volume":
+        raw = coalition_mask * sizes.astype(jnp.float32)
+    elif kind == "local-score":
+        raw = coalition_mask * last_scores
+    else:
+        raise KeyError(f"aggregation approach '{kind}' is not a valid approach. "
+                       f"Supported: {AGGREGATOR_NAMES}")
+    total = jnp.sum(raw)
+    return raw / jnp.maximum(total, 1e-12)
+
+
+def aggregate(stacked_params, weights: jax.Array):
+    """Fused weighted mean over the partner axis, per pytree leaf.
+
+    stacked_params: pytree with leaves [P, ...]; weights: [P].
+    Returns the aggregated (unstacked) pytree.
+    """
+    def reduce_leaf(leaf):
+        w = weights.astype(leaf.dtype).reshape((-1,) + (1,) * (leaf.ndim - 1))
+        return jnp.sum(leaf * w, axis=0)
+    return jax.tree_util.tree_map(reduce_leaf, stacked_params)
+
+
+def broadcast(params, partners_count: int):
+    """Replicate one pytree along a new leading partner axis (the reference's
+    `partner.model_weights = self.model_weights` broadcast,
+    multi_partner_learning.py:310-311)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: jnp.broadcast_to(leaf[None], (partners_count,) + leaf.shape), params)

@@ -1,0 +1,61 @@
+"""Masked losses and metrics.
+
+The reference delegates loss/accuracy to Keras `model.compile(loss=...,
+metrics=["accuracy"])` (/root/reference/mplc/dataset.py:196-199, :473-477).
+Here they are pure functions over logits so they can live inside `jit`,
+`vmap` (over partners and coalitions) and `shard_map` without modification.
+
+Every function takes an explicit `mask` because partner data is stored as
+padded stacked tensors: padded rows must contribute exactly zero loss and
+zero gradient.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, y_onehot: jax.Array) -> jax.Array:
+    """Per-example categorical cross-entropy from logits. [N, C] -> [N]."""
+    logz = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.sum(y_onehot * logz, axis=-1)
+
+
+def sigmoid_binary_cross_entropy(logits: jax.Array, y: jax.Array) -> jax.Array:
+    """Per-example binary cross-entropy from a single logit. [N, 1] -> [N]."""
+    logits = logits.reshape(logits.shape[0])
+    y = y.reshape(y.shape[0])
+    return jnp.maximum(logits, 0.0) - logits * y + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+
+
+def categorical_correct(logits: jax.Array, y_onehot: jax.Array) -> jax.Array:
+    return (jnp.argmax(logits, axis=-1) == jnp.argmax(y_onehot, axis=-1)).astype(jnp.float32)
+
+
+def binary_correct(logits: jax.Array, y: jax.Array) -> jax.Array:
+    logits = logits.reshape(logits.shape[0])
+    y = y.reshape(y.shape[0])
+    return ((logits > 0.0) == (y > 0.5)).astype(jnp.float32)
+
+
+def masked_loss_and_metrics(loss_kind: str, logits: jax.Array, y: jax.Array,
+                            mask: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Return (mean_loss, accuracy, valid_count) under `mask`.
+
+    Zero-valid-row batches (fully padded, e.g. an inactive partner slot in a
+    coalition) return loss=0, acc=0 rather than NaN so the surrounding
+    vmap/scan stays finite.
+    """
+    if loss_kind == "binary":
+        per_ex_loss = sigmoid_binary_cross_entropy(logits, y)
+        per_ex_correct = binary_correct(logits, y)
+    else:
+        per_ex_loss = softmax_cross_entropy(logits, y)
+        per_ex_correct = categorical_correct(logits, y)
+    mask = mask.astype(jnp.float32)
+    count = jnp.sum(mask)
+    denom = jnp.maximum(count, 1.0)
+    mean_loss = jnp.sum(per_ex_loss * mask) / denom
+    acc = jnp.sum(per_ex_correct * mask) / denom
+    return mean_loss, acc, count
