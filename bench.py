@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Benchmark: exact Shapley on MNIST-scale data, batched coalition sweep.
+
+Workload (mirrors BASELINE.md configs[0] and the reference headline):
+MNIST-shaped dataset (60k train), 3 partners [0.4, 0.3, 0.3], basic random
+split, fedavg + data-volume aggregation, exact Shapley = all 2^3-1 = 7
+coalition trainings. The reference (saved_experiments results.csv) trains
+ONE such fedavg model in ~589 s wall-clock at 50 epochs; exact Shapley there
+costs 7 serialized trainings. Here all 7 coalitions train together as one
+vmapped (and, multi-chip, sharded) batch.
+
+Baseline accounting: reference wall-clock scales ~linearly in epochs, so
+  baseline_seconds = 589 s * (epoch_count / 50) * n_coalitions
+and vs_baseline = baseline_seconds / measured_seconds (higher is better).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Env knobs: BENCH_PARTNERS (default 3), BENCH_EPOCHS (default 8),
+BENCH_DTYPE (default bfloat16 on TPU, float32 on CPU),
+MPLC_TPU_SYNTH_SCALE for smaller data on CPU smoke runs.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_MNIST_FEDAVG_SECONDS = 589.0   # saved_experiments/.../results.csv mean
+REFERENCE_EPOCH_BUDGET = 50
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mplc_tpu.contrib.shapley import powerset_order, shapley_from_characteristic
+    from mplc_tpu.data.datasets import load_mnist
+    from mplc_tpu.data.partner import Partner
+    from mplc_tpu.data.partition import (StackedPartners, compute_batch_sizes,
+                                         split_basic, stack_eval_set)
+    from mplc_tpu.mpl.engine import EvalSet, MplTrainer, TrainConfig
+    from mplc_tpu.parallel.mesh import coalition_sharding
+    from mplc_tpu import constants
+
+    n_partners = int(os.environ.get("BENCH_PARTNERS", "3"))
+    epochs = int(os.environ.get("BENCH_EPOCHS", "8"))
+    platform = jax.devices()[0].platform
+    default_dtype = "float32" if platform == "cpu" else "bfloat16"
+    dtype = os.environ.get("BENCH_DTYPE", default_dtype)
+
+    print(f"[bench] devices={jax.devices()} dtype={dtype} "
+          f"partners={n_partners} epochs={epochs}", file=sys.stderr)
+
+    ds = load_mnist()
+    amounts = [0.4, 0.3, 0.3] if n_partners == 3 else \
+        [1.0 / n_partners] * n_partners
+    amounts = [a / sum(amounts) for a in amounts]
+    partners = [Partner(i) for i in range(n_partners)]
+    split_basic(ds, partners, amounts, "random", minibatch_count=10)
+    compute_batch_sizes(partners, 10, 8, constants.MAX_BATCH_SIZE)
+
+    stacked = StackedPartners.build(partners, 10)
+    val = EvalSet(*stack_eval_set(ds.x_val, ds.y_val, 10, 2048))
+    test = EvalSet(*stack_eval_set(ds.x_test, ds.y_test, 10, 2048))
+
+    cfg = TrainConfig(approach="fedavg", aggregator="data-volume",
+                      epoch_count=epochs, minibatch_count=10,
+                      gradient_updates_per_pass=8, is_early_stopping=False,
+                      record_partner_val=False, compute_dtype=dtype)
+    trainer = MplTrainer(ds.model, cfg)
+
+    coalitions = powerset_order(n_partners)
+    B = len(coalitions)
+    masks = np.zeros((B, n_partners), np.float32)
+    for i, s in enumerate(coalitions):
+        masks[i, list(s)] = 1.0
+    masks = jnp.asarray(masks)
+    rngs = jax.random.split(jax.random.PRNGKey(0), B)
+
+    sharding = coalition_sharding()
+    if sharding is not None and B % sharding.num_devices == 0:
+        masks = jax.device_put(masks, sharding.batch_sharding)
+        rngs = jax.device_put(rngs, sharding.batch_sharding)
+
+    binit = jax.jit(jax.vmap(lambda r: trainer.init_state(r, n_partners)))
+    brun = jax.jit(jax.vmap(trainer.epoch_chunk, in_axes=(0, None, None, 0, 0, None)),
+                   static_argnames=("n_epochs",))
+    bfin = jax.jit(jax.vmap(trainer.finalize, in_axes=(0, None)))
+
+    # compile (excluded from the measurement, like any production sweep
+    # where the executable is cached across the 2^N coalition batches)
+    state = binit(rngs)
+    state = brun(state, stacked, val, masks, rngs, 1)
+    jax.block_until_ready(bfin(state, test))
+    print("[bench] compiled; timing...", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    state = binit(rngs)
+    state = brun(state, stacked, val, masks, rngs, epochs)
+    losses, accs = bfin(state, test)
+    jax.block_until_ready(accs)
+    elapsed = time.perf_counter() - t0
+
+    values = {(): 0.0}
+    accs = np.asarray(accs)
+    for s, a in zip(coalitions, accs):
+        values[s] = float(a)
+    sv = shapley_from_characteristic(n_partners, values)
+    print(f"[bench] coalition accs: {np.round(accs, 4).tolist()}", file=sys.stderr)
+    print(f"[bench] Shapley values: {np.round(sv, 4).tolist()}", file=sys.stderr)
+
+    scale = float(os.environ.get("MPLC_TPU_SYNTH_SCALE", "1.0"))
+    baseline = (REFERENCE_MNIST_FEDAVG_SECONDS * (epochs / REFERENCE_EPOCH_BUDGET)
+                * scale * B)
+    print(json.dumps({
+        "metric": f"exact_shapley_mnist_{n_partners}partners_{epochs}epochs_wallclock",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(baseline / elapsed, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

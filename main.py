@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""CLI experiment driver: YAML grid -> scenarios -> repeats -> results.csv.
+
+Same contract as the reference /root/reference/main.py: `python main.py -f
+config.yml` expands every list-valued parameter into a scenario grid,
+validates every scenario with a dry run before any training, then runs
+n_repeats x scenarios and appends each scenario's `to_dataframe()` rows to
+<experiment>/results.csv.
+"""
+
+import os
+import sys
+
+from mplc_tpu import utils
+from mplc_tpu.scenario import Scenario
+from mplc_tpu.utils import parse_command_line_arguments
+
+DEFAULT_CONFIG_FILE = "./config.yml"
+
+
+def validate_scenario_list(scenario_params_list, experiment_path):
+    """Dry-run every scenario (reference main.py:92-111)."""
+    logger = utils.logger
+    logger.debug("Starting to validate scenarios")
+    for scenario_id, scenario_params in enumerate(scenario_params_list):
+        current_scenario = Scenario(**scenario_params,
+                                    experiment_path=experiment_path,
+                                    is_dry_run=True)
+        current_scenario.instantiate_scenario_partners()
+        if current_scenario.samples_split_type == "basic":
+            current_scenario.split_data(is_logging_enabled=False)
+        elif current_scenario.samples_split_type == "advanced":
+            current_scenario.split_data_advanced(is_logging_enabled=False)
+    logger.debug("All scenarios have been validated")
+
+
+def main(argv=None):
+    args = parse_command_line_arguments(argv)
+    logger = utils.init_logger(debug=args.verbose)
+
+    config_file = args.file or DEFAULT_CONFIG_FILE
+    logger.info(f"Using config file: {config_file}")
+    config = utils.get_config_from_file(config_file)
+
+    scenario_params_list = utils.get_scenario_params_list(
+        config["scenario_params_list"])
+    experiment_path = config["experiment_path"]
+    n_repeats = config["n_repeats"]
+
+    validate_scenario_list(scenario_params_list, experiment_path)
+
+    for scenario_id, scenario_params in enumerate(scenario_params_list):
+        logger.info(f"Scenario {scenario_id + 1}/{len(scenario_params_list)}: "
+                    f"{scenario_params}")
+
+    utils.set_log_file(experiment_path)
+
+    for i in range(n_repeats):
+        logger.info(f"Repeat {i + 1}/{n_repeats}")
+        for scenario_id, scenario_params in enumerate(scenario_params_list):
+            logger.info(f"Scenario {scenario_id + 1}/{len(scenario_params_list)}")
+            current_scenario = Scenario(**scenario_params,
+                                        experiment_path=experiment_path,
+                                        scenario_id=scenario_id + 1,
+                                        repeats_count=i + 1)
+            current_scenario.run()
+
+            df_results = current_scenario.to_dataframe()
+            df_results["random_state"] = i
+            df_results["scenario_id"] = scenario_id
+
+            results_path = experiment_path / "results.csv"
+            with open(results_path, "a") as f:
+                df_results.to_csv(f, header=f.tell() == 0, index=False)
+            logger.info(f"Results saved to {os.path.relpath(results_path)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+from .contributivity import Contributivity, KrigingModel, power_set
+from .engine import CharacteristicEngine
+from .shapley import (shapley_from_characteristic, powerset_order,
+                      subset_to_bitmask, bitmask_to_subset)
+
+__all__ = [
+    "Contributivity", "KrigingModel", "power_set", "CharacteristicEngine",
+    "shapley_from_characteristic", "powerset_order", "subset_to_bitmask",
+    "bitmask_to_subset",
+]

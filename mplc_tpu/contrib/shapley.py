@@ -1,0 +1,58 @@
+"""Exact Shapley values from a characteristic-function table.
+
+Replaces the reference's vendored susobhang70 implementation
+(/root/reference/mplc/contributivity.py:1205-1253) — which rebuilds the
+power set and calls `list.index` per term (O(4^n) lookups) — with direct
+bit-twiddling over coalition bitmasks: O(n·2^n) with O(1) lookups.
+"""
+
+from __future__ import annotations
+
+from math import factorial
+
+import numpy as np
+
+
+def subset_to_bitmask(subset) -> int:
+    m = 0
+    for i in subset:
+        m |= 1 << int(i)
+    return m
+
+
+def bitmask_to_subset(mask: int) -> tuple:
+    out = []
+    i = 0
+    while mask:
+        if mask & 1:
+            out.append(i)
+        mask >>= 1
+        i += 1
+    return tuple(out)
+
+
+def powerset_order(n: int) -> list[tuple]:
+    """The reference's coalition enumeration order: all subsets sorted by
+    size then lexicographically (contributivity.py:149-151) — kept for
+    results parity in logs/CSV."""
+    from itertools import combinations
+    return [tuple(c) for k in range(1, n + 1) for c in combinations(range(n), k)]
+
+
+def shapley_from_characteristic(n: int, value_of: dict) -> np.ndarray:
+    """value_of: dict mapping sorted subset tuple -> v(S); v(empty)=0.
+
+    SV_i = sum_{S not containing i} |S|! (n-|S|-1)! / n! * (v(S+i) - v(S)).
+    """
+    v = np.zeros(1 << n)
+    for subset, val in value_of.items():
+        v[subset_to_bitmask(subset)] = val
+    weights = np.array([factorial(k) * factorial(n - k - 1) / factorial(n)
+                        for k in range(n)])
+    sv = np.zeros(n)
+    for mask in range(1 << n):
+        size = bin(mask).count("1")
+        for i in range(n):
+            if not (mask >> i) & 1:
+                sv[i] += weights[size] * (v[mask | (1 << i)] - v[mask])
+    return sv

@@ -161,8 +161,8 @@ def _digits_prototypes() -> np.ndarray | None:
     protos = np.zeros((10, 28, 28), np.float32)
     for c in range(10):
         mean_img = imgs[d.target == c].mean(axis=0)
-        up = np.kron(mean_img, np.ones((4, 4)))[:28, :28]  # 32x32 -> crop
-        protos[c, 2:30 - 2, 2:30 - 2] = up[:24, :24]
+        up = np.kron(mean_img, np.ones((3, 3)))  # 8x8 -> 24x24
+        protos[c, 2:26, 2:26] = up
     return protos
 
 

@@ -1,0 +1,4 @@
+from .mesh import (CoalitionSharding, coalition_sharding, make_mesh,
+                   make_2d_mesh)
+
+__all__ = ["CoalitionSharding", "coalition_sharding", "make_mesh", "make_2d_mesh"]

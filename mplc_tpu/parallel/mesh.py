@@ -1,0 +1,62 @@
+"""Device meshes and shardings for coalition / partner parallelism.
+
+The reference has no distributed backend at all (SURVEY.md §2.3); this
+module is where the TPU framework defines its scale-out story:
+
+  - `coal` axis: the primary parallel axis. Independent coalition trainings
+    (or scenario-grid cells) shard over it; they share nothing until their
+    scalar scores are gathered, so it rides ICI with essentially zero
+    communication and scales linearly in chips.
+  - `part` axis (optional 2-D mesh): shards the partner dimension of the
+    stacked data/params inside one coalition training for very large P;
+    the masked aggregation reduction then becomes a `psum` over `part`.
+
+All helpers degrade gracefully to single-device (bench on one chip, tests on
+a CPU with XLA_FLAGS=--xla_force_host_platform_device_count=8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class CoalitionSharding:
+    mesh: Mesh
+    batch_sharding: NamedSharding      # shard leading (coalition) axis
+    replicated: NamedSharding
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.devices.size
+
+
+def make_mesh(devices=None, axis_name: str = "coal") -> Mesh:
+    devices = jax.devices() if devices is None else devices
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def coalition_sharding(devices=None) -> CoalitionSharding | None:
+    """Sharding spec for a batch of coalition trainings; None on 1 device."""
+    devices = jax.devices() if devices is None else devices
+    if len(devices) <= 1:
+        return None
+    mesh = make_mesh(devices)
+    return CoalitionSharding(
+        mesh=mesh,
+        batch_sharding=NamedSharding(mesh, P("coal")),
+        replicated=NamedSharding(mesh, P()),
+    )
+
+
+def make_2d_mesh(coal: int, part: int, devices=None) -> Mesh:
+    """[coal, part] mesh: coalition batch x partner sharding."""
+    devices = jax.devices() if devices is None else devices
+    assert coal * part == len(devices), (
+        f"mesh {coal}x{part} needs {coal * part} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices).reshape(coal, part), ("coal", "part"))

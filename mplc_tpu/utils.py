@@ -1,0 +1,133 @@
+"""Config loading, experiment-grid expansion, logging, result folders.
+
+Mirrors /root/reference/mplc/utils.py: YAML experiment files with the shape
+{experiment_name, n_repeats, scenario_params_list}, where every list-valued
+parameter is grid-expanded via itertools.product into one scenario per
+combination (utils.py:41-91), including the dataset-name dict sub-syntax for
+`init_model_from` (utils.py:62-71).
+
+Logging uses stdlib `logging` (the reference uses loguru, which is not
+available here) with the same split: console + per-experiment info.log /
+debug.log files (utils.py:165-200).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import logging
+import sys
+from itertools import product
+from pathlib import Path
+from shutil import copyfile
+
+import yaml
+
+from . import constants
+
+logger = logging.getLogger("mplc_tpu")
+
+
+def load_cfg(yaml_filepath):
+    logger.info("Loading experiment yaml file")
+    with open(yaml_filepath, "r") as stream:
+        cfg = yaml.safe_load(stream)
+    logger.info(str(cfg))
+    return cfg
+
+
+def get_scenario_params_list(config):
+    """Cartesian-product grid expansion (reference utils.py:41-91)."""
+    scenario_params_list = []
+    config_dataset = []
+
+    for list_scenario in config:
+        if isinstance(list_scenario["dataset_name"], dict):
+            for dataset_name in list_scenario["dataset_name"].keys():
+                dataset_scenario = list_scenario.copy()
+                dataset_scenario["dataset_name"] = [dataset_name]
+                if list_scenario["dataset_name"][dataset_name] is None:
+                    dataset_scenario["init_model_from"] = ["random_initialization"]
+                else:
+                    dataset_scenario["init_model_from"] = \
+                        list_scenario["dataset_name"][dataset_name]
+                config_dataset.append(dataset_scenario)
+        else:
+            config_dataset.append(list_scenario)
+
+    for list_scenario in config_dataset:
+        params_name = list_scenario.keys()
+        params_list = list(list_scenario.values())
+        for el in product(*params_list):
+            scenario = dict(zip(params_name, el))
+            if scenario["partners_count"] != len(scenario["amounts_per_partner"]):
+                raise Exception(
+                    "Length of amounts_per_partner does not match number of partners.")
+            if scenario.get("samples_split_option") is not None and \
+                    scenario["samples_split_option"][0] == "advanced" and \
+                    scenario["partners_count"] != len(scenario["samples_split_option"][1]):
+                raise Exception(
+                    "Length of samples_split_option does not match number of partners.")
+            if "corrupted_datasets" in params_name:
+                if scenario["partners_count"] != len(scenario["corrupted_datasets"]):
+                    raise Exception(
+                        "Length of corrupted_datasets does not match number of partners.")
+            scenario_params_list.append(scenario)
+
+    logger.info(f"Number of scenario(s) configured: {len(scenario_params_list)}")
+    return scenario_params_list
+
+
+def init_result_folder(yaml_filepath, cfg):
+    logger.info("Init result folder")
+    now_str = datetime.datetime.now().strftime("%Y-%m-%d_%Hh%M")
+    full_experiment_name = cfg["experiment_name"] + "_" + now_str
+    experiment_path = Path.cwd() / constants.EXPERIMENTS_FOLDER_NAME / full_experiment_name
+    while experiment_path.exists():
+        logger.warning(f"Experiment folder {experiment_path} already exists")
+        experiment_path = Path(str(experiment_path) + "_bis")
+    experiment_path.mkdir(parents=True, exist_ok=False)
+    cfg["experiment_path"] = experiment_path
+    copyfile(yaml_filepath, experiment_path / Path(yaml_filepath).name)
+    logger.info(f"Experiment folder {experiment_path} created.")
+    return cfg
+
+
+def get_config_from_file(config_filepath):
+    config = load_cfg(config_filepath)
+    config = init_result_folder(config_filepath, config)
+    return config
+
+
+def parse_command_line_arguments(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-f", "--file", help="input config file")
+    parser.add_argument("-v", "--verbose", help="verbose output",
+                        action="store_true")
+    return parser.parse_args(argv)
+
+
+def init_logger(debug=False):
+    root = logging.getLogger("mplc_tpu")
+    root.setLevel(logging.DEBUG)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    console = logging.StreamHandler(sys.stdout)
+    console.setLevel(logging.DEBUG if debug else logging.INFO)
+    console.setFormatter(logging.Formatter(
+        "%(asctime)s | %(levelname)s | %(message)s"))
+    root.addHandler(console)
+    return root
+
+
+def set_log_file(path: Path):
+    root = logging.getLogger("mplc_tpu")
+    info_h = logging.FileHandler(Path(path) / constants.INFO_LOGGING_FILE_NAME)
+    info_h.setLevel(logging.INFO)
+    debug_h = logging.FileHandler(Path(path) / constants.DEBUG_LOGGING_FILE_NAME)
+    debug_h.setLevel(logging.DEBUG)
+    fmt = logging.Formatter("%(asctime)s | %(levelname)s | %(message)s")
+    info_h.setFormatter(fmt)
+    debug_h.setFormatter(fmt)
+    root.addHandler(info_h)
+    root.addHandler(debug_h)

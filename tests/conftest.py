@@ -1,0 +1,60 @@
+"""Test harness setup: force a virtual 8-device CPU mesh BEFORE jax import.
+
+Mirrors the reference test strategy (SURVEY.md §4): real objects on small
+real configs, no fakes for the training path; multi-device behavior is
+exercised on a host-platform device mesh.
+"""
+
+import os
+
+# HARD override: the ambient environment pins JAX_PLATFORMS=axon (single
+# real TPU chip behind a tunnel) and the axon sitecustomize sets the
+# jax_platforms *config value* at interpreter startup — so an env-var
+# override alone is ignored. Tests must run on the virtual 8-device CPU
+# mesh instead of contending for the chip: set the XLA flag before backend
+# init, then force the config back to cpu.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("MPLC_TPU_SYNTH_SCALE", "0.02")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_image_dataset():
+    """A small, learnable prototype-image dataset shared across tests."""
+    from mplc_tpu.data.datasets import Dataset, to_categorical
+    from mplc_tpu.models import MNIST_CNN
+
+    rng = np.random.default_rng(7)
+    protos = rng.uniform(0, 1, (10, 28, 28, 1)).astype(np.float32)
+    def make(n):
+        y = rng.integers(0, 10, n)
+        x = np.clip(protos[y] + rng.normal(0, 0.25, (n, 28, 28, 1)), 0, 1).astype(np.float32)
+        return x, to_categorical(y, 10)
+    x, y = make(700)
+    xt, yt = make(150)
+    return Dataset("mnist", (28, 28, 1), 10, x, y, xt, yt,
+                   model=MNIST_CNN, provenance="test")
+
+
+@pytest.fixture(scope="session")
+def quick_scenario(tiny_image_dataset):
+    """A 3-partner fedavg scenario, split and ready to train."""
+    from mplc_tpu.scenario import Scenario
+    sc = Scenario(partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+                  dataset=tiny_image_dataset, epoch_count=2, minibatch_count=2,
+                  gradient_updates_per_pass_count=2, is_early_stopping=False,
+                  experiment_path="/tmp/mplc_tpu_tests", seed=3)
+    sc.instantiate_scenario_partners()
+    sc.split_data(is_logging_enabled=False)
+    sc.compute_batch_sizes()
+    sc.data_corruption()
+    return sc

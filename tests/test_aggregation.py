@@ -1,0 +1,50 @@
+"""Aggregation op: weight policies and masked reduction parity with np.average."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mplc_tpu.ops.aggregation import aggregate, aggregation_weights, broadcast
+
+
+def test_uniform_weights_masked():
+    w = aggregation_weights("uniform", jnp.array([1., 1., 0.]),
+                            jnp.array([10, 20, 30]), jnp.array([0.5, 0.6, 0.7]))
+    assert np.allclose(np.asarray(w), [0.5, 0.5, 0.0])
+
+
+def test_data_volume_weights():
+    w = aggregation_weights("data-volume", jnp.array([1., 1., 1.]),
+                            jnp.array([10, 20, 70]), jnp.zeros(3))
+    assert np.allclose(np.asarray(w), [0.1, 0.2, 0.7])
+
+
+def test_local_score_weights():
+    w = aggregation_weights("local-score", jnp.array([1., 0., 1.]),
+                            jnp.array([1, 1, 1]), jnp.array([0.2, 0.9, 0.6]))
+    assert np.allclose(np.asarray(w), [0.25, 0.0, 0.75])
+
+
+def test_unknown_aggregator_raises():
+    with pytest.raises(KeyError):
+        aggregation_weights("nope", jnp.ones(2), jnp.ones(2), jnp.ones(2))
+
+
+def test_aggregate_matches_np_average():
+    rng = np.random.default_rng(0)
+    stacked = {"w": jnp.asarray(rng.normal(size=(3, 4, 5)).astype(np.float32)),
+               "b": jnp.asarray(rng.normal(size=(3, 5)).astype(np.float32))}
+    weights = np.array([0.2, 0.3, 0.5], np.float32)
+    out = aggregate(stacked, jnp.asarray(weights))
+    ref_w = np.average(np.asarray(stacked["w"]), axis=0, weights=weights)
+    ref_b = np.average(np.asarray(stacked["b"]), axis=0, weights=weights)
+    assert np.allclose(np.asarray(out["w"]), ref_w, atol=1e-6)
+    assert np.allclose(np.asarray(out["b"]), ref_b, atol=1e-6)
+
+
+def test_broadcast_round_trip():
+    params = {"w": jnp.arange(6.0).reshape(2, 3)}
+    st = broadcast(params, 4)
+    assert st["w"].shape == (4, 2, 3)
+    back = aggregate(st, jnp.full((4,), 0.25))
+    assert np.allclose(np.asarray(back["w"]), np.asarray(params["w"]), atol=1e-6)

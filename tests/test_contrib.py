@@ -1,0 +1,208 @@
+"""Contributivity estimators against an analytic characteristic function.
+
+An additive game v(S) = sum of per-partner values has Shapley value exactly
+equal to each partner's value, with zero-variance marginals — so every
+Shapley estimator must recover it. The engine is faked (no training), which
+makes these the fast structural tests; end-to-end training-backed tests live
+in test_e2e.py.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+from mplc_tpu.contrib.contributivity import Contributivity, KrigingModel
+from mplc_tpu.contrib.engine import CharacteristicEngine
+from mplc_tpu.contrib.shapley import (bitmask_to_subset, powerset_order,
+                                      shapley_from_characteristic,
+                                      subset_to_bitmask)
+
+
+class FakeEngine(CharacteristicEngine):
+    """CharacteristicEngine with the trainers replaced by a closed-form v(S)."""
+
+    def __init__(self, n, value_fn):
+        self.partners_count = n
+        self.value_fn = value_fn
+        self.charac_fct_values = {(): 0.0}
+        self.increments_values = [dict() for _ in range(n)]
+        self.first_charac_fct_calls_count = 0
+        self._sharding = None
+
+    def _run_batch(self, subsets, pipe=None):
+        for s in subsets:
+            self._store(s, float(self.value_fn(s)))
+
+    def evaluate(self, subsets):
+        keys = [tuple(sorted(int(i) for i in s)) for s in subsets]
+        missing = [k for k in dict.fromkeys(keys) if k not in self.charac_fct_values]
+        self._run_batch(missing)
+        return np.array([self.charac_fct_values[k] for k in keys])
+
+
+def fake_scenario(n, value_fn, sizes=None):
+    sc = types.SimpleNamespace()
+    sizes = sizes if sizes is not None else [100 * (i + 1) for i in range(n)]
+    partners = []
+    for i in range(n):
+        p = types.SimpleNamespace(id=i, y_train=np.zeros(sizes[i]))
+        partners.append(p)
+    sc.partners_list = partners
+    sc.seed = 0
+    sc.multi_partner_learning_approach_key = "fedavg"
+    sc._charac_engine = FakeEngine(n, value_fn)
+    return sc
+
+
+def additive(phi):
+    return lambda s: sum(phi[i] for i in s)
+
+
+PHI3 = [0.1, 0.25, 0.65]
+PHI5 = [0.05, 0.1, 0.15, 0.3, 0.4]
+
+
+# -- bit-twiddling exact SV --------------------------------------------------
+
+def test_bitmask_round_trip():
+    assert subset_to_bitmask((0, 2, 5)) == 0b100101
+    assert bitmask_to_subset(0b100101) == (0, 2, 5)
+
+
+def test_powerset_order_matches_reference_enumeration():
+    from itertools import combinations
+    n = 4
+    ref = [tuple(j) for i in range(n) for j in combinations(range(n), i + 1)]
+    assert powerset_order(n) == ref
+
+
+def test_exact_sv_additive_game():
+    n = 4
+    phi = [0.4, 0.1, 0.3, 0.2]
+    values = {s: sum(phi[i] for i in s) for s in powerset_order(n)}
+    sv = shapley_from_characteristic(n, values)
+    assert np.allclose(sv, phi, atol=1e-12)
+
+
+def test_exact_sv_symmetric_game():
+    # v(S) = |S|^2: symmetric -> equal SVs summing to v(N)
+    n = 3
+    values = {s: len(s) ** 2 for s in powerset_order(n)}
+    sv = shapley_from_characteristic(n, values)
+    assert np.allclose(sv, [3.0, 3.0, 3.0])
+
+
+# -- methods on the fake engine ---------------------------------------------
+
+def test_compute_SV():
+    sc = fake_scenario(3, additive(PHI3))
+    c = Contributivity(sc)
+    c.compute_SV()
+    assert np.allclose(c.contributivity_scores, PHI3, atol=1e-9)
+    assert c.first_charac_fct_calls_count == 7
+
+
+def test_independent_scores():
+    sc = fake_scenario(3, additive(PHI3))
+    c = Contributivity(sc)
+    c.compute_independent_scores()
+    assert np.allclose(c.contributivity_scores, PHI3, atol=1e-9)
+
+
+def test_tmcs_additive():
+    sc = fake_scenario(5, additive(PHI5))
+    c = Contributivity(sc)
+    c.truncated_MC(sv_accuracy=0.05, alpha=0.9, truncation=0.0)
+    assert np.allclose(c.contributivity_scores, PHI5, atol=1e-9)
+
+
+def test_tmcs_truncation_saves_evaluations():
+    sc = fake_scenario(5, additive(PHI5))
+    c = Contributivity(sc)
+    c.truncated_MC(sv_accuracy=0.05, alpha=0.9, truncation=0.5)
+    # with truncation 0.5 some subsets (e.g. {1,2,3,4}: all its predecessors
+    # have v within 0.5 of v(N)) can never be reached -> strictly fewer than
+    # the full 2^5-1 coalition trainings
+    assert c.first_charac_fct_calls_count < 31
+
+
+def test_itmcs_additive():
+    sc = fake_scenario(4, additive([0.1, 0.2, 0.3, 0.4]))
+    c = Contributivity(sc)
+    c.interpol_TMC(sv_accuracy=0.05, alpha=0.9, truncation=0.0)
+    assert np.allclose(c.contributivity_scores, [0.1, 0.2, 0.3, 0.4], atol=1e-9)
+
+
+def test_is_lin_additive():
+    sc = fake_scenario(4, additive([0.1, 0.2, 0.3, 0.4]))
+    c = Contributivity(sc)
+    c.IS_lin(sv_accuracy=0.05, alpha=0.95)
+    assert np.allclose(c.contributivity_scores, [0.1, 0.2, 0.3, 0.4], atol=1e-6)
+
+
+def test_is_reg_additive():
+    phi = [0.1, 0.2, 0.3, 0.15, 0.25]
+    sc = fake_scenario(5, additive(phi))
+    c = Contributivity(sc)
+    c.IS_reg(sv_accuracy=0.05, alpha=0.95)
+    assert np.allclose(c.contributivity_scores, phi, atol=0.05)
+
+
+def test_is_reg_small_n_falls_back_to_exact():
+    sc = fake_scenario(3, additive(PHI3))
+    c = Contributivity(sc)
+    c.IS_reg()
+    assert c.name == "IS_reg Shapley values"
+    assert np.allclose(c.contributivity_scores, PHI3, atol=1e-9)
+
+
+def test_ais_kriging_additive():
+    phi = [0.1, 0.2, 0.3, 0.4]
+    sc = fake_scenario(4, additive(phi))
+    c = Contributivity(sc)
+    c.AIS_Kriging(sv_accuracy=0.05, alpha=0.95, update=50)
+    assert np.allclose(c.contributivity_scores, phi, atol=0.05)
+
+
+def test_smcs_additive():
+    phi = [0.1, 0.2, 0.3, 0.4]
+    sc = fake_scenario(4, additive(phi))
+    c = Contributivity(sc)
+    c.Stratified_MC(sv_accuracy=0.05, alpha=0.95)
+    assert np.allclose(c.contributivity_scores, phi, atol=1e-9)
+
+
+def test_wr_smc_additive():
+    phi = [0.1, 0.2, 0.3, 0.4]
+    sc = fake_scenario(4, additive(phi))
+    c = Contributivity(sc)
+    c.without_replacment_SMC(sv_accuracy=0.05, alpha=0.95)
+    assert np.allclose(c.contributivity_scores, phi, atol=1e-9)
+
+
+def test_dispatcher_unknown_method_is_ignored():
+    sc = fake_scenario(3, additive(PHI3))
+    c = Contributivity(sc)
+    c.compute_contributivity("No such method")
+    assert np.allclose(c.contributivity_scores, np.zeros(3))
+
+
+def test_engine_cache_shared_between_methods():
+    sc = fake_scenario(3, additive(PHI3))
+    c1 = Contributivity(sc)
+    c1.compute_SV()
+    calls_after_sv = c1.first_charac_fct_calls_count
+    c2 = Contributivity(sc)
+    c2.compute_independent_scores()
+    # singletons were already cached by the SV sweep
+    assert c2.first_charac_fct_calls_count == calls_after_sv
+
+
+def test_kriging_model_interpolates():
+    model = KrigingModel(1, lambda a, b: np.exp(-np.sum((np.asarray(a) - np.asarray(b)) ** 2)))
+    X = [np.array([0.0]), np.array([1.0]), np.array([2.0])]
+    Y = np.array([0.0, 1.0, 2.0])
+    model.fit(X, Y)
+    for x, y in zip(X, Y):
+        assert abs(model.predict(x) - y) < 1e-4

@@ -1,0 +1,184 @@
+"""Data layer: loaders, splits, corruption ops, stacking invariants."""
+
+import numpy as np
+import pytest
+
+from mplc_tpu import constants
+from mplc_tpu.data.datasets import (Dataset, load_dataset, to_categorical,
+                                    synthetic_image_classification)
+from mplc_tpu.data.partition import (StackedPartners, compute_batch_sizes,
+                                     split_advanced, split_basic, stack_eval_set)
+from mplc_tpu.data.partner import Partner
+
+
+@pytest.mark.parametrize("name", constants.SUPPORTED_DATASETS_NAMES)
+def test_builtin_loaders(name):
+    ds = load_dataset(name)
+    assert ds.name == name
+    assert len(ds.x_train) > 0 and len(ds.x_val) > 0 and len(ds.x_test) > 0
+    assert ds.x_train.shape[1:] == ds.input_shape
+    assert ds.model is not None
+    # global split is 90/10
+    total = len(ds.x_train) + len(ds.x_val)
+    assert abs(len(ds.x_val) / total - 0.1) < 0.02
+
+
+def test_double_global_split_raises():
+    x, y = synthetic_image_classification(np.random.default_rng(0), 50, (8, 8, 1), 3)
+    ds = Dataset("d", (8, 8, 1), 3, x, to_categorical(y, 3), x, to_categorical(y, 3))
+    with pytest.raises(Exception):
+        ds.train_val_split_global()
+
+
+def test_shorten_dataset_proportion():
+    x, y = synthetic_image_classification(np.random.default_rng(0), 200, (8, 8, 1), 3)
+    ds = Dataset("d", (8, 8, 1), 3, x, to_categorical(y, 3), x[:20], to_categorical(y[:20], 3))
+    n0 = len(ds.x_train)
+    ds.shorten_dataset_proportion(0.5)
+    assert len(ds.x_train) == int(round(n0 * 0.5))
+
+
+def _mk_dataset(n=300, c=4):
+    x, y = synthetic_image_classification(np.random.default_rng(1), n, (6, 6, 1), c)
+    return Dataset("d", (6, 6, 1), c, x, to_categorical(y, c),
+                   x[:30], to_categorical(y[:30], c))
+
+
+def test_split_basic_random_amounts():
+    ds = _mk_dataset()
+    partners = [Partner(i) for i in range(3)]
+    split_basic(ds, partners, [0.5, 0.3, 0.2], "random", minibatch_count=2)
+    n = len(ds.x_train)
+    sizes = [len(p.x_train) for p in partners]
+    assert sum(sizes) == n
+    assert abs(sizes[0] / n - 0.5) < 0.02
+    # deterministic: same seed-42 shuffle
+    partners2 = [Partner(i) for i in range(3)]
+    split_basic(ds, partners2, [0.5, 0.3, 0.2], "random", minibatch_count=2)
+    assert np.array_equal(partners[0].x_train, partners2[0].x_train)
+
+
+def test_split_basic_stratified_clusters():
+    ds = _mk_dataset(400, 4)
+    partners = [Partner(i) for i in range(4)]
+    split_basic(ds, partners, [0.25, 0.25, 0.25, 0.25], "stratified", minibatch_count=2)
+    # stratified: each partner covers a narrow label range
+    for p in partners:
+        assert len(p.clusters_list) <= 3
+
+
+def test_split_basic_bad_amounts_raises():
+    ds = _mk_dataset()
+    partners = [Partner(i) for i in range(2)]
+    with pytest.raises(AssertionError):
+        split_basic(ds, partners, [0.5, 0.4], "random", minibatch_count=2)
+
+
+def test_split_advanced():
+    ds = _mk_dataset(600, 4)
+    partners = [Partner(i) for i in range(3)]
+    desc = [[2, "shared"], [2, "shared"], [1, "specific"]]
+    split_advanced(ds, partners, [0.4, 0.4, 0.2], desc, minibatch_count=2)
+    assert all(len(p.x_train) > 0 for p in partners)
+    assert len(partners[2].clusters_list) == 1
+    # specific partner's labels must be the single assigned cluster
+    enc_labels = set(np.argmax(partners[2].y_train, axis=1).tolist())
+    assert len(enc_labels) == 1
+
+
+def test_compute_batch_sizes():
+    partners = [Partner(i) for i in range(2)]
+    for p, n in zip(partners, [100, 1000]):
+        p.x_train = np.zeros((n, 2))
+        p.y_train = np.zeros((n, 2))
+    compute_batch_sizes(partners, minibatch_count=5,
+                        gradient_updates_per_pass_count=2, max_batch_size=1 << 20)
+    assert partners[0].batch_size == 10
+    assert partners[1].batch_size == 100
+    single = [partners[1]]
+    compute_batch_sizes(single, 5, 2, 1 << 20)
+    assert partners[1].batch_size == 500
+
+
+# -- corruption ops ----------------------------------------------------------
+
+def _one_hot_partner(n=60, c=5):
+    p = Partner(0)
+    y = np.random.default_rng(3).integers(0, c, n)
+    p.y_train = to_categorical(y, c)
+    p.x_train = np.zeros((n, 2), np.float32)
+    return p
+
+
+def test_corrupt_labels_offsets():
+    p = _one_hot_partner()
+    before = np.argmax(p.y_train, axis=1).copy()
+    p.corrupt_labels(1.0)
+    after = np.argmax(p.y_train, axis=1)
+    # every label moved to class-1 (mod C)
+    assert np.array_equal(after, (before - 1) % p.y_train.shape[1])
+    assert np.allclose(p.y_train.sum(axis=1), 1.0)
+
+
+def test_permute_labels_matrix_is_permutation():
+    p = _one_hot_partner()
+    p.permute_labels(1.0)
+    m = p.corruption_matrix
+    assert np.array_equal(m.sum(axis=0), np.ones(m.shape[0]))
+    assert np.array_equal(m.sum(axis=1), np.ones(m.shape[0]))
+    assert np.allclose(p.y_train.sum(axis=1), 1.0)
+
+
+def test_random_labels_keeps_onehot():
+    p = _one_hot_partner()
+    p.random_labels(1.0)
+    assert np.allclose(p.y_train.sum(axis=1), 1.0)
+    assert ((p.y_train == 0) | (p.y_train == 1)).all()
+
+
+def test_shuffle_labels_proportion():
+    p = _one_hot_partner(100)
+    before = p.y_train.copy()
+    p.shuffle_labels(0.5)
+    changed = (np.argmax(p.y_train, 1) != np.argmax(before, 1)).mean()
+    assert 0.1 < changed < 0.6  # ~50% selected, each shuffle changes w.p. (C-1)/C
+    assert np.allclose(p.y_train.sum(axis=1), 1.0)
+
+
+def test_corruption_proportion_bounds():
+    p = _one_hot_partner()
+    with pytest.raises(ValueError):
+        p.corrupt_labels(1.5)
+
+
+def test_corruption_on_integer_labels():
+    p = Partner(0)
+    p.y_train = np.random.default_rng(0).integers(0, 4, 50)
+    p.x_train = np.zeros((50, 2))
+    p.permute_labels(1.0)
+    assert p.y_train.ndim == 1  # demoted back to integer labels
+
+
+# -- stacking ---------------------------------------------------------------
+
+def test_stacked_partners_layout():
+    partners = []
+    for i, n in enumerate([20, 35, 10]):
+        p = Partner(i)
+        p.x_train = np.full((n, 3, 3, 1), i, np.float32)
+        p.y_train = to_categorical(np.zeros(n, int), 4)
+        partners.append(p)
+    st = StackedPartners.build(partners, 4)
+    assert st.x.shape == (3, 35, 3, 3, 1)
+    assert st.sizes.tolist() == [20, 35, 10]
+    assert float(st.mask[0].sum()) == 20
+    assert float(st.mask[2, 10:].sum()) == 0
+    assert float(st.x[2, 5, 0, 0, 0]) == 2.0
+
+
+def test_stack_eval_set_chunks():
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    y = np.zeros((10, 2), np.float32)
+    cx, cy, cm = stack_eval_set(x, y, 2, chunk=4)
+    assert cx.shape == (3, 4, 1)
+    assert float(cm.sum()) == 10

@@ -1,0 +1,121 @@
+"""End-to-end tests: real Scenario.run() with training-backed contributivity,
+the contributivity-ordering oracle, and the CLI driver.
+
+Mirrors the reference e2e strategy (/root/reference/tests/
+end_to_end_tests.py): threshold asserts on the final score and the semantic
+oracle that a partner holding 90% of the data must out-score a partner
+holding 10%, for every method.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mplc_tpu.data.datasets import Dataset, to_categorical
+from mplc_tpu.models import MNIST_CNN
+from mplc_tpu.scenario import Scenario
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _mk_dataset(n=900, noise=0.25, seed=11):
+    rng = np.random.default_rng(seed)
+    protos = rng.uniform(0, 1, (10, 28, 28, 1)).astype(np.float32)
+    def make(m):
+        y = rng.integers(0, 10, m)
+        x = np.clip(protos[y] + rng.normal(0, noise, (m, 28, 28, 1)), 0, 1)
+        return x.astype(np.float32), to_categorical(y, 10)
+    x, y = make(n)
+    xt, yt = make(n // 4)
+    return Dataset("mnist", (28, 28, 1), 10, x, y, xt, yt,
+                   model=MNIST_CNN, provenance="test")
+
+
+@pytest.mark.slow
+def test_scenario_run_trains_to_threshold():
+    sc = Scenario(partners_count=3, amounts_per_partner=[0.3, 0.3, 0.4],
+                  dataset=_mk_dataset(), epoch_count=4, minibatch_count=2,
+                  gradient_updates_per_pass_count=4, is_early_stopping=False,
+                  experiment_path="/tmp/mplc_tpu_tests", seed=5)
+    sc.run()
+    assert sc.mpl.history.score > 0.8
+    # artifacts written
+    assert (sc.save_folder / "graphs" / "data_distribution.png").exists()
+    assert (sc.save_folder / "model" / "mnist_final_weights.npz").exists()
+
+
+@pytest.mark.slow
+def test_contributivity_ordering_oracle():
+    """0.1/0.9 split: the 0.9 partner must out-score the 0.1 partner for the
+    training-backed methods (reference end_to_end_tests.py:54-73)."""
+    sc = Scenario(partners_count=2, amounts_per_partner=[0.1, 0.9],
+                  dataset=_mk_dataset(1200, noise=0.45, seed=13),
+                  epoch_count=3, minibatch_count=2,
+                  gradient_updates_per_pass_count=3, is_early_stopping=False,
+                  methods=["Shapley values", "Independent scores", "TMCS"],
+                  experiment_path="/tmp/mplc_tpu_tests", seed=6)
+    sc.run()
+    assert len(sc.contributivity_list) == 3
+    for contrib in sc.contributivity_list:
+        s = contrib.contributivity_scores
+        assert s[1] > s[0], f"{contrib.name}: {s}"
+
+
+@pytest.mark.slow
+def test_sbs_lflip_pvrl_methods():
+    sc = Scenario(partners_count=2, amounts_per_partner=[0.4, 0.6],
+                  dataset=_mk_dataset(500, seed=17), epoch_count=3,
+                  minibatch_count=2, gradient_updates_per_pass_count=2,
+                  is_early_stopping=False,
+                  methods=["Federated SBS linear", "Federated SBS quadratic",
+                           "Federated SBS constant", "LFlip", "PVRL"],
+                  experiment_path="/tmp/mplc_tpu_tests", seed=7)
+    sc.run()
+    assert len(sc.contributivity_list) == 5
+    for contrib in sc.contributivity_list:
+        assert np.isfinite(contrib.contributivity_scores).all(), contrib.name
+        assert contrib.contributivity_scores.shape == (2,)
+    df = sc.to_dataframe()
+    assert len(df) == 5 * 2  # methods x partners
+    assert "contributivity_score" in df.columns
+
+
+@pytest.mark.slow
+def test_cli_end_to_end(tmp_path):
+    """`python main.py -f cfg.yml` writes results.csv (reference
+    end_to_end_tests.py:36-42)."""
+    cfg = tmp_path / "cfg.yml"
+    cfg.write_text(
+        "experiment_name: e2e_test\n"
+        "n_repeats: 1\n"
+        "scenario_params_list:\n"
+        "  - dataset_name:\n"
+        "      mnist: null\n"
+        "    partners_count: [2]\n"
+        "    amounts_per_partner: [[0.4, 0.6]]\n"
+        "    samples_split_option: [['basic', 'random']]\n"
+        "    multi_partner_learning_approach: ['fedavg']\n"
+        "    aggregation_weighting: ['uniform']\n"
+        "    epoch_count: [2]\n"
+        "    minibatch_count: [2]\n"
+        "    gradient_updates_per_pass_count: [2]\n"
+        "    is_early_stopping: [False]\n"
+        "    methods: [['Independent scores']]\n")
+    env = {"MPLC_TPU_SYNTH_SCALE": "0.01", "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin:/usr/local/bin",
+           "HOME": "/root"}
+    res = subprocess.run([sys.executable, str(REPO / "main.py"), "-f", str(cfg)],
+                         cwd=tmp_path, env=env, capture_output=True, text=True,
+                         timeout=1200)
+    assert res.returncode == 0, res.stderr[-3000:]
+    exp_dirs = list((tmp_path / "experiments").glob("e2e_test_*"))
+    assert exp_dirs, "experiment folder not created"
+    results = exp_dirs[0] / "results.csv"
+    assert results.exists()
+    import pandas as pd
+    df = pd.read_csv(results)
+    assert (df["mpl_test_score"] > 0.5).all()
+    assert (df["contributivity_method"] == "Independent scores raw").any()

@@ -1,0 +1,173 @@
+"""MPL engine + approach classes: training behavior, masking, early stopping."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mplc_tpu.data.partition import StackedPartners, stack_eval_set
+from mplc_tpu.data.partner import Partner
+from mplc_tpu.data.datasets import to_categorical
+from mplc_tpu.models import MNIST_CNN, TITANIC_LOGREG
+from mplc_tpu.mpl.engine import EvalSet, MplTrainer, TrainConfig
+from mplc_tpu.mpl.approaches import (MULTI_PARTNER_LEARNING_APPROACHES,
+                                     FederatedAverageLearning,
+                                     SinglePartnerLearning)
+
+
+@pytest.fixture(scope="module")
+def small_logreg_problem():
+    """Fast linearly-separable problem on the tiny logistic model."""
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=27)
+    def make(n):
+        x = rng.normal(size=(n, 27)).astype(np.float32)
+        y = (x @ w > 0).astype(np.float32)
+        return x, y
+    partners = []
+    for i, n in enumerate([200, 150, 100]):
+        p = Partner(i)
+        p.x_train, p.y_train = make(n)
+        partners.append(p)
+    stacked = StackedPartners.build(partners, 1)
+    val = EvalSet(*stack_eval_set(*make(120), 1, 128))
+    test = EvalSet(*stack_eval_set(*make(120), 1, 128))
+    return stacked, val, test
+
+
+def _run(trainer, stacked, val, mask, n_epochs, rng=0):
+    state = trainer.init_state(jax.random.PRNGKey(rng), stacked.x.shape[0])
+    run = jax.jit(trainer.epoch_chunk, static_argnames=("n_epochs",))
+    return run(state, stacked, val, mask, jax.random.PRNGKey(rng + 1),
+               n_epochs=n_epochs)
+
+
+@pytest.mark.parametrize("approach", ["fedavg", "seq-pure", "seqavg",
+                                      "seq-with-final-agg"])
+def test_all_approaches_learn(small_logreg_problem, approach):
+    stacked, val, test = small_logreg_problem
+    cfg = TrainConfig(approach=approach, aggregator="data-volume", epoch_count=4,
+                      minibatch_count=2, gradient_updates_per_pass=4,
+                      is_early_stopping=False, record_partner_val=False)
+    tr = MplTrainer(TITANIC_LOGREG, cfg)
+    state = _run(tr, stacked, val, jnp.ones(3), 4)
+    _, acc = jax.jit(tr.finalize)(state, test)
+    assert float(acc) > 0.8, f"{approach} failed to learn: acc={float(acc)}"
+
+
+def test_coalition_mask_excludes_partner(small_logreg_problem):
+    """An inactive partner must not influence training: a coalition of
+    {0} with partners 1,2 masked must equal training on partner 0 data only."""
+    stacked, val, test = small_logreg_problem
+    cfg = TrainConfig(approach="fedavg", aggregator="uniform", epoch_count=2,
+                      minibatch_count=2, gradient_updates_per_pass=2,
+                      is_early_stopping=False, record_partner_val=False)
+    tr = MplTrainer(TITANIC_LOGREG, cfg)
+    state_masked = _run(tr, stacked, val, jnp.array([1., 0., 0.]), 2)
+
+    # same training with a stack containing only partner 0
+    solo = StackedPartners(stacked.x[:1], stacked.y[:1], stacked.mask[:1],
+                           stacked.sizes[:1])
+    tr1 = MplTrainer(TITANIC_LOGREG, cfg)
+    state_solo = _run(tr1, solo, val, jnp.ones(1), 2)
+
+    for a, b in zip(jax.tree_util.tree_leaves(state_masked.params),
+                    jax.tree_util.tree_leaves(state_solo.params)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_batched_coalitions_match_individual(small_logreg_problem):
+    """vmapped mask batch must give the same scores as one-at-a-time runs."""
+    stacked, val, test = small_logreg_problem
+    cfg = TrainConfig(approach="fedavg", aggregator="uniform", epoch_count=2,
+                      minibatch_count=2, gradient_updates_per_pass=2,
+                      is_early_stopping=False, record_partner_val=False)
+    tr = MplTrainer(TITANIC_LOGREG, cfg)
+    masks = jnp.array([[1, 1, 0], [0, 1, 1], [1, 1, 1]], jnp.float32)
+    rngs = jnp.stack([jax.random.PRNGKey(5)] * 3)
+
+    binit = jax.jit(jax.vmap(lambda r: tr.init_state(r, 3)))
+    brun = jax.jit(jax.vmap(tr.epoch_chunk, in_axes=(0, None, None, 0, 0, None)),
+                   static_argnames=("n_epochs",))
+    bfin = jax.jit(jax.vmap(tr.finalize, in_axes=(0, None)))
+    bstate = brun(binit(rngs), stacked, val, masks, rngs, 2)
+    _, batch_accs = bfin(bstate, test)
+
+    for i in range(3):
+        state = tr.init_state(jax.random.PRNGKey(5), 3)
+        run = jax.jit(tr.epoch_chunk, static_argnames=("n_epochs",))
+        state = run(state, stacked, val, masks[i], jax.random.PRNGKey(5), n_epochs=2)
+        _, acc = jax.jit(tr.finalize)(state, test)
+        assert np.isclose(float(acc), float(batch_accs[i]), atol=1e-5)
+
+
+def test_early_stopping_freezes(small_logreg_problem):
+    stacked, val, test = small_logreg_problem
+    cfg = TrainConfig(approach="fedavg", aggregator="uniform", epoch_count=8,
+                      minibatch_count=2, gradient_updates_per_pass=2,
+                      is_early_stopping=True, patience=2, record_partner_val=False)
+    tr = MplTrainer(TITANIC_LOGREG, cfg)
+    state = _run(tr, stacked, val, jnp.ones(3), 8)
+    nb = int(state.nb_epochs_done)
+    assert 1 <= nb <= 8
+    if bool(state.done) and nb < 8:
+        # frozen: history rows after stopping remain NaN
+        assert np.isnan(np.asarray(state.val_loss_h)[nb:, 0]).all()
+
+
+def test_single_trainer(small_logreg_problem):
+    stacked, val, test = small_logreg_problem
+    cfg = TrainConfig(approach="single", aggregator="uniform", epoch_count=4,
+                      minibatch_count=2, gradient_updates_per_pass=4,
+                      is_early_stopping=False, record_partner_val=False)
+    tr = MplTrainer(TITANIC_LOGREG, cfg)
+    state = _run(tr, stacked, val, jnp.array([0., 1., 0.]), 4)
+    _, acc = jax.jit(tr.finalize)(state, test)
+    assert float(acc) > 0.75
+
+
+def test_history_matrices_filled(small_logreg_problem):
+    stacked, val, test = small_logreg_problem
+    cfg = TrainConfig(approach="fedavg", aggregator="uniform", epoch_count=2,
+                      minibatch_count=3, gradient_updates_per_pass=2,
+                      is_early_stopping=False, record_partner_val=True)
+    tr = MplTrainer(TITANIC_LOGREG, cfg)
+    state = _run(tr, stacked, val, jnp.ones(3), 2)
+    assert not np.isnan(np.asarray(state.val_loss_h)).any()
+    ph = np.asarray(state.partner_h)  # [4, P, E, MB]
+    assert ph.shape == (4, 3, 2, 3)
+    assert not np.isnan(ph).any()
+
+
+# -- approach classes over a real scenario ----------------------------------
+
+def test_registry_keys():
+    assert set(MULTI_PARTNER_LEARNING_APPROACHES) == {
+        "fedavg", "seq-pure", "seq-with-final-agg", "seqavg", "lflip"}
+
+
+def test_fedavg_class_runs(quick_scenario):
+    mpl = FederatedAverageLearning(quick_scenario)
+    score = mpl.fit()
+    assert 0.0 <= score <= 1.0
+    assert mpl.learning_computation_time > 0
+    hist = mpl.history
+    assert hist.score == score
+    assert hist.history["mpl_model"]["val_loss"].shape == (2, 2)
+    df = hist.partners_to_dataframe()
+    assert set(["Partner", "Epoch", "Minibatch"]).issubset(df.columns)
+
+
+def test_fedavg_requires_multiple_partners(quick_scenario):
+    import copy
+    sc = copy.copy(quick_scenario)
+    sc.partners_list = quick_scenario.partners_list[:1]
+    with pytest.raises(ValueError):
+        FederatedAverageLearning(sc)
+
+
+def test_single_partner_class(quick_scenario):
+    mpl = SinglePartnerLearning(quick_scenario,
+                                partner=quick_scenario.partners_list[0])
+    score = mpl.fit()
+    assert 0.0 <= score <= 1.0

@@ -1,0 +1,121 @@
+"""Scenario parameter surface, validation, grid expansion, results schema."""
+
+import numpy as np
+import pytest
+
+from mplc_tpu.scenario import Scenario
+from mplc_tpu.utils import get_scenario_params_list
+
+
+def _tiny_kwargs(ds, **over):
+    kw = dict(partners_count=3, amounts_per_partner=[0.3, 0.3, 0.4], dataset=ds,
+              epoch_count=2, minibatch_count=2, gradient_updates_per_pass_count=2,
+              is_early_stopping=False, experiment_path="/tmp/mplc_tpu_tests",
+              is_dry_run=True)
+    kw.update(over)
+    return kw
+
+
+def test_unknown_kwarg_raises(tiny_image_dataset):
+    with pytest.raises(Exception, match="Unrecognised parameters"):
+        Scenario(**_tiny_kwargs(tiny_image_dataset), not_a_param=1)
+
+
+def test_unknown_approach_raises(tiny_image_dataset):
+    with pytest.raises(KeyError):
+        Scenario(**_tiny_kwargs(tiny_image_dataset),
+                 multi_partner_learning_approach="nope")
+
+
+def test_aggregation_alias_spellings(tiny_image_dataset):
+    sc1 = Scenario(**_tiny_kwargs(tiny_image_dataset),
+                   aggregation_weighting="data_volume")
+    sc2 = Scenario(**_tiny_kwargs(tiny_image_dataset),
+                   aggregation_weighting="data-volume")
+    assert sc1.aggregation_name == sc2.aggregation_name == "data-volume"
+    with pytest.raises(ValueError):
+        Scenario(**_tiny_kwargs(tiny_image_dataset), aggregation_weighting="bogus")
+
+
+def test_unknown_method_raises(tiny_image_dataset):
+    with pytest.raises(Exception, match="not in methods list"):
+        Scenario(**_tiny_kwargs(tiny_image_dataset), methods=["Not a method"])
+
+
+def test_bad_dataset_proportion(tiny_image_dataset):
+    with pytest.raises(AssertionError):
+        Scenario(**_tiny_kwargs(tiny_image_dataset), dataset_proportion=0)
+
+
+def test_default_split_is_basic_random(tiny_image_dataset):
+    sc = Scenario(**_tiny_kwargs(tiny_image_dataset))
+    assert (sc.samples_split_type, sc.samples_split_description) == ("basic", "random")
+
+
+def test_corrupted_datasets_default(tiny_image_dataset):
+    sc = Scenario(**_tiny_kwargs(tiny_image_dataset))
+    assert sc.corrupted_datasets == ["not_corrupted"] * 3
+
+
+def test_dry_run_skips_folder(tmp_path, tiny_image_dataset):
+    sc = Scenario(**{**_tiny_kwargs(tiny_image_dataset),
+                     "experiment_path": tmp_path / "exp", "is_dry_run": True})
+    assert not sc.save_folder.exists()
+
+
+def test_to_dataframe_without_contrib(tiny_image_dataset):
+    sc = Scenario(**_tiny_kwargs(tiny_image_dataset))
+    df = sc.to_dataframe()
+    assert len(df) == 1
+    assert "mpl_test_score" in df.columns
+
+
+# -- grid expansion ----------------------------------------------------------
+
+def test_grid_expansion_product():
+    cfg = [{
+        "dataset_name": ["mnist"],
+        "partners_count": [3],
+        "amounts_per_partner": [[0.2, 0.3, 0.5]],
+        "epoch_count": [2, 4],
+        "minibatch_count": [2, 3],
+    }]
+    params = get_scenario_params_list(cfg)
+    assert len(params) == 4
+    assert {p["epoch_count"] for p in params} == {2, 4}
+
+
+def test_grid_expansion_mismatched_amounts_raises():
+    cfg = [{
+        "dataset_name": ["mnist"],
+        "partners_count": [3],
+        "amounts_per_partner": [[0.5, 0.5]],
+    }]
+    with pytest.raises(Exception, match="amounts_per_partner"):
+        get_scenario_params_list(cfg)
+
+
+def test_grid_expansion_dataset_dict_init_model():
+    cfg = [{
+        "dataset_name": {"mnist": None},
+        "partners_count": [2],
+        "amounts_per_partner": [[0.5, 0.5]],
+    }]
+    params = get_scenario_params_list(cfg)
+    assert params[0]["dataset_name"] == "mnist"
+    assert params[0]["init_model_from"] == "random_initialization"
+
+
+def test_split_then_corruption_pipeline(tiny_image_dataset):
+    sc = Scenario(**_tiny_kwargs(tiny_image_dataset),
+                  corrupted_datasets=["not_corrupted", "permuted", ["shuffled", 0.5]])
+    sc.instantiate_scenario_partners()
+    sc.split_data(is_logging_enabled=False)
+    sc.compute_batch_sizes()
+    y_before = [p.y_train.copy() for p in sc.partners_list]
+    sc.data_corruption()
+    assert np.array_equal(sc.partners_list[0].y_train, y_before[0])
+    assert not np.array_equal(sc.partners_list[1].y_train, y_before[1])
+    # one-hot structure preserved everywhere
+    for p in sc.partners_list:
+        assert np.allclose(p.y_train.sum(axis=1), 1.0)
