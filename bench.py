@@ -84,22 +84,33 @@ def main():
         rngs = jax.device_put(rngs, sharding.batch_sharding)
 
     binit = jax.jit(jax.vmap(lambda r: trainer.init_state(r, n_partners)))
-    brun = jax.jit(jax.vmap(trainer.epoch_chunk, in_axes=(0, None, None, 0, 0, None)),
-                   static_argnames=("n_epochs",))
+
+    def run_all_epochs(state, stacked, val, masks, rngs):
+        return jax.vmap(trainer.epoch_chunk,
+                        in_axes=(0, None, None, 0, 0, None))(
+            state, stacked, val, masks, rngs, epochs)
+
+    brun = jax.jit(run_all_epochs)
     bfin = jax.jit(jax.vmap(trainer.finalize, in_axes=(0, None)))
 
-    # compile (excluded from the measurement, like any production sweep
-    # where the executable is cached across the 2^N coalition batches)
+    # AOT-compile the exact executables used in the timed region (excluded
+    # from the measurement, like any production sweep where the executable
+    # is cached across the 2^N coalition batches), then execute once to warm
+    # any lazy runtime initialization.
     state = binit(rngs)
-    state = brun(state, stacked, val, masks, rngs, 1)
-    jax.block_until_ready(bfin(state, test))
+    brun_c = brun.lower(state, stacked, val, masks, rngs).compile()
+    bfin_c = bfin.lower(state, test).compile()
+    warm = bfin_c(brun_c(state, stacked, val, masks, rngs), test)
+    np.asarray(warm[1])
     print("[bench] compiled; timing...", file=sys.stderr)
 
+    # Time until the scores are on the host: a host fetch is the only sync
+    # that every backend (incl. the tunneled axon TPU) honors.
     t0 = time.perf_counter()
     state = binit(rngs)
-    state = brun(state, stacked, val, masks, rngs, epochs)
-    losses, accs = bfin(state, test)
-    jax.block_until_ready(accs)
+    state = brun_c(state, stacked, val, masks, rngs)
+    losses, accs = bfin_c(state, test)
+    accs = np.asarray(accs)
     elapsed = time.perf_counter() - t0
 
     values = {(): 0.0}

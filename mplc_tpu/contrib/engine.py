@@ -53,17 +53,14 @@ class BatchedTrainerPipeline:
     def __init__(self, trainer: MplTrainer, partners_count: int):
         self.trainer = trainer
         self.partners_count = partners_count
-        self._init = jax.jit(jax.vmap(
-            lambda r: trainer.init_state(r, partners_count)))
-        self._run = jax.jit(jax.vmap(trainer.epoch_chunk,
-                                     in_axes=(0, None, None, 0, 0, None)),
-                            static_argnames=("n_epochs",))
-        self._fin = jax.jit(jax.vmap(trainer.finalize, in_axes=(0, None)))
+        self._init = trainer.jit_batched_init
+        self._run = trainer.jit_batched_epoch_chunk
+        self._fin = trainer.jit_batched_finalize
 
     def scores(self, masks: jnp.ndarray, rngs: jnp.ndarray, stacked, val, test,
                base_rng) -> np.ndarray:
         cfg = self.trainer.cfg
-        state = self._init(rngs)
+        state = self._init(rngs, self.partners_count)
         chunk = cfg.patience if cfg.is_early_stopping else cfg.epoch_count
         chunk = max(1, min(chunk, cfg.epoch_count))
         epochs_left = cfg.epoch_count
@@ -111,9 +108,9 @@ class CharacteristicEngine:
                                 **base)
         single_cfg = TrainConfig(approach="single", **base)
         self.multi_pipe = BatchedTrainerPipeline(
-            MplTrainer(self.model, multi_cfg), self.partners_count)
+            MplTrainer.get(self.model, multi_cfg), self.partners_count)
         self.single_pipe = BatchedTrainerPipeline(
-            MplTrainer(self.model, single_cfg), self.partners_count)
+            MplTrainer.get(self.model, single_cfg), self.partners_count)
 
         self.charac_fct_values: dict[tuple, float] = {(): 0.0}
         self.increments_values = [dict() for _ in range(self.partners_count)]
@@ -189,3 +186,69 @@ class CharacteristicEngine:
     def not_twice_characteristic(self, subset) -> float:
         """Reference-API single-subset entry (contributivity.py:92-136)."""
         return float(self.evaluate([np.atleast_1d(np.asarray(subset, int))])[0])
+
+    # ------------------------------------------------------------------
+    # checkpoint / resume: long Shapley sweeps are resumable because the
+    # characteristic function is fully described by its memo cache. The
+    # reference checkpoints only final model weights
+    # (multi_partner_learning.py:117-128); persisting the coalition cache
+    # is the improvement its structure invites (SURVEY.md §5).
+    # ------------------------------------------------------------------
+
+    def _fingerprint(self) -> dict:
+        """Everything v(S) depends on: a cache from a run with a different
+        value for any of these would describe a different game."""
+        cfg = self.multi_pipe.trainer.cfg
+        return {
+            "partners_count": self.partners_count,
+            "seed": self.seed,
+            "dataset": getattr(self.scenario.dataset, "name", "?"),
+            "model": self.model.name,
+            "approach": cfg.approach,
+            "aggregator": cfg.aggregator,
+            "epoch_count": cfg.epoch_count,
+            "minibatch_count": cfg.minibatch_count,
+            "gradient_updates_per_pass": cfg.gradient_updates_per_pass,
+            "partner_sizes": [int(s) for s in
+                              np.asarray(self.stacked.sizes).tolist()],
+        }
+
+    def save_cache(self, path) -> None:
+        """Persist v(S) memo + increment bookkeeping as JSON."""
+        import json
+        payload = {
+            "fingerprint": self._fingerprint(),
+            "first_charac_fct_calls_count": self.first_charac_fct_calls_count,
+            "charac_fct_values": [[list(k), v]
+                                  for k, v in self.charac_fct_values.items()],
+            "increments_values": [[[list(k), v] for k, v in d.items()]
+                                  for d in self.increments_values],
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f)
+
+    def load_cache(self, path) -> None:
+        """Restore a saved cache; a cache from a scenario whose training
+        setup differs in ANY v(S)-relevant way raises."""
+        import json
+        with open(path) as f:
+            payload = json.load(f)
+        theirs = payload.get("fingerprint", {})
+        ours = self._fingerprint()
+        if "partners_count" in theirs and \
+                theirs["partners_count"] != ours["partners_count"]:
+            raise ValueError(
+                f"cache was built for {theirs['partners_count']} partners, "
+                f"scenario has {ours['partners_count']}")
+        mismatched = {k: (theirs.get(k), v) for k, v in ours.items()
+                      if theirs.get(k) != v}
+        if mismatched:
+            raise ValueError(
+                "coalition cache was built under a different scenario setup — "
+                "characteristic values would not be comparable. Mismatches "
+                f"(cache vs scenario): {mismatched}")
+        self.charac_fct_values = {tuple(k): v
+                                  for k, v in payload["charac_fct_values"]}
+        self.increments_values = [{tuple(k): v for k, v in entries}
+                                  for entries in payload["increments_values"]]
+        self.first_charac_fct_calls_count = payload["first_charac_fct_calls_count"]

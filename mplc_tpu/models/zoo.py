@@ -184,7 +184,7 @@ CIFAR10_CNN = Model("cifar10_cnn", _cifar_init, _cifar_apply, "categorical", 10,
 IMDB_CONV1D = Model("imdb_conv1d", _imdb_init, _imdb_apply, "binary", 1, adam_like_keras)
 ESC50_CNN = Model("esc50_cnn", _esc50_init, _esc50_apply, "categorical", 50, adam_like_keras)
 TITANIC_LOGREG = Model("titanic_logreg", _titanic_init, _titanic_apply, "binary", 1,
-                       partial(adam_like_keras, 1e-2))
+                       partial(adam_like_keras, 5e-2))
 
 MODELS = {
     "mnist_cnn": MNIST_CNN,

@@ -95,7 +95,7 @@ class MultiPartnerLearning:
             is_early_stopping=self.is_early_stopping,
             compute_dtype=self.compute_dtype,
         )
-        self.trainer = MplTrainer(self.model, self.cfg)
+        self.trainer = MplTrainer.get(self.model, self.cfg)
         self.history = History([p.id for p in self.partners_list],
                                self.epoch_count, self.minibatch_count,
                                save_folder=self.save_folder)
@@ -135,7 +135,7 @@ class MultiPartnerLearning:
 
         chunk = self.cfg.patience if self.cfg.is_early_stopping else self.cfg.epoch_count
         chunk = max(1, min(chunk, self.cfg.epoch_count))
-        run = jax.jit(self.trainer.epoch_chunk, static_argnames=("n_epochs",))
+        run = self.trainer.jit_epoch_chunk
         epochs_left = self.cfg.epoch_count
         while epochs_left > 0:
             n = min(chunk, epochs_left)
@@ -144,7 +144,7 @@ class MultiPartnerLearning:
             if bool(jax.device_get(state.done)):
                 break
 
-        test_loss, test_acc = jax.jit(self.trainer.finalize)(state, test)
+        test_loss, test_acc = self.trainer.jit_finalize(state, test)
         self._state = state
         self.model_params = state.params
         self.epoch_index = int(jax.device_get(state.epoch))
@@ -214,7 +214,7 @@ class MplLabelFlip(MultiPartnerLearning):
         self.epsilon = epsilon
         import dataclasses
         self.cfg = dataclasses.replace(self.cfg, lflip_epsilon=epsilon)
-        self.trainer = MplTrainer(self.model, self.cfg)
+        self.trainer = MplTrainer.get(self.model, self.cfg)
 
 
 class SinglePartnerLearning(MultiPartnerLearning):

@@ -74,12 +74,21 @@ class TrainConfig:
     compute_dtype: str = "float32"
     record_partner_val: bool = True
     lflip_epsilon: float = 0.01
+    # Name of the mesh axis the partner dimension is sharded over (shard_map);
+    # None = all partners resident on each device. Only the vmap-parallel
+    # approaches (fedavg/lflip) can shard partners; seq-* visit partners
+    # serially and `single` reduces to one partner.
+    partner_axis: str | None = None
 
     def __post_init__(self):
         if self.approach not in APPROACH_NAMES:
             raise KeyError(
                 f"Multi-partner learning approach '{self.approach}' is not a valid "
                 f"approach. List of supported approaches: {', '.join(APPROACH_NAMES)}")
+        if self.partner_axis is not None and self.approach not in ("fedavg", "lflip"):
+            raise ValueError(
+                f"partner-axis sharding requires a partner-parallel approach "
+                f"(fedavg/lflip), got '{self.approach}'")
 
     @property
     def dtype(self):
@@ -127,6 +136,66 @@ class MplTrainer:
         self.cfg = cfg
         self.opt = model.make_optimizer()
         self.label_dim = model.label_dim()
+        self._jits: dict = {}
+
+    # ------------------------------------------------------------------
+    # shared-instance + jit caches: XLA compilations are keyed on function
+    # identity, so every fresh MplTrainer (one per approach object in the
+    # reference API) would otherwise recompile identical programs. `get`
+    # dedupes trainer instances per (model, cfg); the jit_* properties pin
+    # one jitted callable per instance. The registry holds weak references
+    # so that trainers (and their compiled executables) from long-finished
+    # grid scenarios are reclaimable — live approach objects keep theirs
+    # alive through their own strong reference.
+    # ------------------------------------------------------------------
+
+    _instances = None  # lazily a WeakValueDictionary
+
+    @classmethod
+    def get(cls, model: Model, cfg: TrainConfig) -> "MplTrainer":
+        import weakref
+        if cls._instances is None:
+            cls._instances = weakref.WeakValueDictionary()
+        key = (id(model), cfg)
+        inst = cls._instances.get(key)
+        if inst is None:
+            inst = cls(model, cfg)
+            cls._instances[key] = inst
+        return inst
+
+    @property
+    def jit_epoch_chunk(self):
+        if "epoch_chunk" not in self._jits:
+            self._jits["epoch_chunk"] = jax.jit(
+                self.epoch_chunk, static_argnames=("n_epochs",))
+        return self._jits["epoch_chunk"]
+
+    @property
+    def jit_finalize(self):
+        if "finalize" not in self._jits:
+            self._jits["finalize"] = jax.jit(self.finalize)
+        return self._jits["finalize"]
+
+    @property
+    def jit_batched_init(self):
+        if "binit" not in self._jits:
+            self._jits["binit"] = jax.jit(
+                jax.vmap(self.init_state, in_axes=(0, None)), static_argnums=(1,))
+        return self._jits["binit"]
+
+    @property
+    def jit_batched_epoch_chunk(self):
+        if "brun" not in self._jits:
+            self._jits["brun"] = jax.jit(
+                jax.vmap(self.epoch_chunk, in_axes=(0, None, None, 0, 0, None)),
+                static_argnames=("n_epochs",))
+        return self._jits["brun"]
+
+    @property
+    def jit_batched_finalize(self):
+        if "bfin" not in self._jits:
+            self._jits["bfin"] = jax.jit(jax.vmap(self.finalize, in_axes=(0, None)))
+        return self._jits["bfin"]
 
     # ------------------------------------------------------------------
     # state init
@@ -183,11 +252,19 @@ class MplTrainer:
     # data selection helpers (all static shapes)
     # ------------------------------------------------------------------
 
-    def _epoch_perms(self, rng, mask_pn):
+    def _epoch_perms(self, rng, mask_pn, offset=0):
         """Per-partner random permutation of real rows: [P, Nmax] indices with
-        all valid rows first, in random order."""
-        keys = jax.random.uniform(rng, mask_pn.shape) + (1.0 - mask_pn) * 1e9
-        return jnp.argsort(keys, axis=1).astype(jnp.int32)
+        all valid rows first, in random order. Keys are derived per GLOBAL
+        partner index (`offset` = shard offset under partner sharding) so a
+        sharded run shuffles identically to the unsharded one."""
+        P = mask_pn.shape[0]
+
+        def one(i, mask_p):
+            keys = jax.random.uniform(jax.random.fold_in(rng, i), mask_p.shape) \
+                + (1.0 - mask_p) * 1e9
+            return jnp.argsort(keys).astype(jnp.int32)
+
+        return jax.vmap(one)(jnp.arange(P, dtype=jnp.int32) + offset, mask_pn)
 
     def _subbatch(self, perm_p, size_p, mb_i, g, sb_cap):
         """Indices + mask for gradient step g of minibatch mb_i of one partner."""
@@ -325,7 +402,12 @@ class MplTrainer:
         cfg = self.cfg
         P = stacked.x.shape[0]
         e = state.epoch
-        perms = self._epoch_perms(jax.random.fold_in(rng, 0), stacked.mask)
+        if cfg.partner_axis is not None:
+            shard_offset = jax.lax.axis_index(cfg.partner_axis) * P
+        else:
+            shard_offset = 0
+        perms = self._epoch_perms(jax.random.fold_in(rng, 0), stacked.mask,
+                                  offset=shard_offset)
         lflip = cfg.approach == "lflip"
         n_max = stacked.x.shape[1]
         mb_cap = max(n_max // cfg.minibatch_count, 1)
@@ -337,7 +419,10 @@ class MplTrainer:
             va_h = va_h.at[e, mb_i].set(va)
 
             rng_mb = jax.random.fold_in(jax.random.fold_in(rng, 1), mb_i)
-            p_rngs = jax.random.split(rng_mb, P)
+            # Per-partner rng keyed by GLOBAL partner index, so a
+            # partner-sharded run trains identically to the unsharded one.
+            p_rngs = jax.vmap(lambda i: jax.random.fold_in(rng_mb, i))(
+                jnp.arange(P, dtype=jnp.int32) + shard_offset)
 
             if lflip:
                 def one(theta_p, x_p, y_p, perm_p, size_p, act, r):
@@ -368,8 +453,9 @@ class MplTrainer:
                                        jnp.stack([losses, accs, pvl, pva]))
 
             w = aggregation_weights(cfg.aggregator, coal_mask,
-                                    stacked.sizes, jnp.nan_to_num(pva))
-            params = aggregate(new_params, w)
+                                    stacked.sizes, jnp.nan_to_num(pva),
+                                    axis_name=cfg.partner_axis)
+            params = aggregate(new_params, w, axis_name=cfg.partner_axis)
             return (params, theta, vl_h, va_h, p_h), None
 
         (params, theta, vl_h, va_h, p_h), _ = lax.scan(

@@ -27,13 +27,16 @@ AGGREGATOR_NAMES = ("uniform", "data-volume", "local-score")
 
 
 def aggregation_weights(kind: str, coalition_mask: jax.Array,
-                        sizes: jax.Array, last_scores: jax.Array) -> jax.Array:
+                        sizes: jax.Array, last_scores: jax.Array,
+                        axis_name: str | None = None) -> jax.Array:
     """Build the normalized weight vector w[P] for one aggregation step.
 
     kind: 'uniform' | 'data-volume' | 'local-score'
     coalition_mask: [P] float 0/1 — inactive partners get weight 0.
     sizes: [P] sample counts (data-volume policy).
     last_scores: [P] last-round val accuracy (local-score policy).
+    axis_name: if the partner axis is sharded over a mesh axis (shard_map),
+        its name — normalization then uses the GLOBAL total via `psum`.
     """
     if kind == "uniform":
         raw = coalition_mask
@@ -45,18 +48,24 @@ def aggregation_weights(kind: str, coalition_mask: jax.Array,
         raise KeyError(f"aggregation approach '{kind}' is not a valid approach. "
                        f"Supported: {AGGREGATOR_NAMES}")
     total = jnp.sum(raw)
+    if axis_name is not None:
+        total = jax.lax.psum(total, axis_name)
     return raw / jnp.maximum(total, 1e-12)
 
 
-def aggregate(stacked_params, weights: jax.Array):
+def aggregate(stacked_params, weights: jax.Array, axis_name: str | None = None):
     """Fused weighted mean over the partner axis, per pytree leaf.
 
     stacked_params: pytree with leaves [P, ...]; weights: [P].
-    Returns the aggregated (unstacked) pytree.
+    Returns the aggregated (unstacked) pytree. With `axis_name`, the local
+    partial sums are `psum`ed over the mesh axis the partner dimension is
+    sharded on — this is the framework's cross-chip weight "communication"
+    (one reduce per aggregation, riding ICI).
     """
     def reduce_leaf(leaf):
         w = weights.astype(leaf.dtype).reshape((-1,) + (1,) * (leaf.ndim - 1))
-        return jnp.sum(leaf * w, axis=0)
+        s = jnp.sum(leaf * w, axis=0)
+        return jax.lax.psum(s, axis_name) if axis_name is not None else s
     return jax.tree_util.tree_map(reduce_leaf, stacked_params)
 
 

@@ -1,4 +1,6 @@
 from .mesh import (CoalitionSharding, coalition_sharding, make_mesh,
                    make_2d_mesh)
+from .partner_shard import PartnerShardedTrainer
 
-__all__ = ["CoalitionSharding", "coalition_sharding", "make_mesh", "make_2d_mesh"]
+__all__ = ["CoalitionSharding", "coalition_sharding", "make_mesh",
+           "make_2d_mesh", "PartnerShardedTrainer"]

@@ -1,0 +1,127 @@
+"""Partner-axis sharding: federated training with partners spread over chips.
+
+The reference holds every partner in one process and "communicates" weights
+through a Python list average (/root/reference/mplc/mpl_utils.py:90-102).
+Stacking partners on a leading axis already turns that into one fused
+reduction (ops/aggregation.py); this module adds the second mesh dimension:
+for large partner counts (or large per-partner data) the stacked `[P, ...]`
+tensors are sharded over a `part` mesh axis with `shard_map`, each device
+trains its local partner shard with the same vmapped kernel, and the
+per-round aggregation becomes ONE `psum` over ICI per pytree leaf — the
+framework's cross-chip weight communication.
+
+Training-identical guarantee: every per-partner RNG (epoch shuffles, dropout,
+lflip draws) is keyed by GLOBAL partner index (mpl/engine.py `_epoch_perms`,
+`_fedavg_epoch`), so a partner-sharded run produces the same training
+trajectory as the unsharded one up to reduction order.
+
+Composes with coalition parallelism: a 2-D `[coal, part]` mesh
+(parallel/mesh.py `make_2d_mesh`) shards the coalition batch over `coal` and
+partners over `part`; the coalition axis still needs no communication.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+try:
+    from jax import shard_map as _shard_map_raw
+except ImportError:  # older JAX
+    from jax.experimental.shard_map import shard_map as _shard_map_raw
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data.partition import StackedPartners
+from ..mpl.engine import EvalSet, MplTrainer, TrainState
+
+
+def shard_map_norep(f, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across JAX API versions
+    (new API: check_vma; old API: check_rep)."""
+    try:
+        return _shard_map_raw(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return _shard_map_raw(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
+
+def train_state_specs(axis: str) -> TrainState:
+    """PartitionSpec pytree-prefix for a TrainState whose partner-indexed
+    leaves (theta, partner history) are sharded over `axis`."""
+    r = P()
+    return TrainState(params=r, opt_state=r, theta=P(axis), epoch=r, done=r,
+                      nb_epochs_done=r, best_val_loss=r, es_wait=r,
+                      val_loss_h=r, val_acc_h=r, partner_h=P(None, axis))
+
+
+def stacked_specs(axis: str) -> StackedPartners:
+    s = P(axis)
+    return StackedPartners(x=s, y=s, mask=s, sizes=s)
+
+
+class PartnerShardedTrainer:
+    """Runs an `MplTrainer` (fedavg/lflip, cfg.partner_axis set) with the
+    partner axis sharded over `mesh`'s `axis` dimension.
+
+    The public methods mirror MplTrainer's (init_state / epoch_chunk /
+    finalize) but operate on GLOBAL arrays; shard_map splits them. The
+    global partner count must be divisible by the mesh axis size (pad with
+    empty partners — mask 0, size 0 — to round up; padded slots contribute
+    zero weight everywhere).
+    """
+
+    def __init__(self, trainer: MplTrainer, mesh: Mesh, axis: str = "part"):
+        cfg = trainer.cfg
+        if cfg.partner_axis != axis:
+            raise ValueError(
+                f"trainer.cfg.partner_axis={cfg.partner_axis!r} must equal the "
+                f"mesh axis {axis!r} (build the TrainConfig with partner_axis)")
+        self.trainer = trainer
+        self.mesh = mesh
+        self.axis = axis
+        self.n_shards = mesh.shape[axis]
+        self._st = train_state_specs(axis)
+        self._sp = stacked_specs(axis)
+        self._jits = {}
+
+    def data_shardings(self):
+        """(stacked_sharding, replicated) NamedShardings for device_put."""
+        return (jax.tree_util.tree_map(
+                    lambda spec: NamedSharding(self.mesh, spec), self._sp),
+                NamedSharding(self.mesh, P()))
+
+    def init_state(self, rng: jax.Array, partners_count: int) -> TrainState:
+        if partners_count % self.n_shards:
+            raise ValueError(
+                f"global partner count {partners_count} not divisible by "
+                f"{self.n_shards} shards — pad with empty partners")
+        local = partners_count // self.n_shards
+        key = ("init", partners_count)
+        if key not in self._jits:
+            f = shard_map_norep(lambda r: self.trainer.init_state(r, local),
+                                mesh=self.mesh, in_specs=(P(),),
+                                out_specs=self._st)
+            self._jits[key] = jax.jit(f)
+        return self._jits[key](rng)
+
+    def epoch_chunk(self, state: TrainState, stacked: StackedPartners,
+                    val: EvalSet, coal_mask: jax.Array, rng: jax.Array,
+                    n_epochs: int) -> TrainState:
+        key = ("run", n_epochs)
+        if key not in self._jits:
+            f = shard_map_norep(
+                partial(self.trainer.epoch_chunk, n_epochs=n_epochs),
+                mesh=self.mesh,
+                in_specs=(self._st, self._sp, P(), P(self.axis), P()),
+                out_specs=self._st)
+            self._jits[key] = jax.jit(f)
+        return self._jits[key](state, stacked, val, coal_mask, rng)
+
+    def finalize(self, state: TrainState, test: EvalSet):
+        """Global params are replicated after aggregation; evaluate locally."""
+        if "fin" not in self._jits:
+            self._jits["fin"] = jax.jit(self.trainer.finalize)
+        return self._jits["fin"](state, test)

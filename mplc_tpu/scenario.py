@@ -57,7 +57,7 @@ _PARAMS_KNOWN = [
     "gradient_updates_per_pass_count", "epoch_count", "minibatch_count",
     "is_early_stopping",
     "init_model_from", "is_quick_demo",
-    "seed", "compute_dtype",
+    "seed", "compute_dtype", "contributivity_cache_from",
 ]
 
 
@@ -85,6 +85,7 @@ class Scenario:
                  is_dry_run=False,
                  seed=42,
                  compute_dtype="float32",
+                 contributivity_cache_from=None,
                  **kwargs):
         unrecognised = [k for k in kwargs if k not in _PARAMS_KNOWN]
         if unrecognised:
@@ -158,6 +159,9 @@ class Scenario:
 
         self.seed = seed
         self.compute_dtype = compute_dtype
+        # resumable Shapley sweeps: path to a coalition cache saved by a
+        # previous run of the same scenario shape (SURVEY.md §5 rebuild note)
+        self.contributivity_cache_from = contributivity_cache_from
 
         # -- contributivity methods -------------------------------------
         self.contributivity_list: list[Contributivity] = []
@@ -310,9 +314,17 @@ class Scenario:
         for method in self.methods:
             logger.info(f"{method}")
             contrib = Contributivity(scenario=self)
+            if self.contributivity_cache_from and \
+                    not self._charac_engine.first_charac_fct_calls_count:
+                self._charac_engine.load_cache(self.contributivity_cache_from)
+                logger.info(f"Resumed coalition cache from "
+                            f"{self.contributivity_cache_from} "
+                            f"({len(self._charac_engine.charac_fct_values)} entries)")
             contrib.compute_contributivity(method)
             self.append_contributivity(contrib)
             logger.info(f"## Evaluating contributivity with {method}: {contrib}")
+        if self.methods and self._charac_engine is not None and not self.is_dry_run:
+            self._charac_engine.save_cache(self.save_folder / "coalition_cache.json")
         return 0
 
     # ------------------------------------------------------------------

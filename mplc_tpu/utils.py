@@ -120,6 +120,34 @@ def init_logger(debug=False):
     return root
 
 
+class profile_trace:
+    """jax.profiler trace hook (SURVEY.md §5: the reference only has
+    timeit wall-clock pairs; this adds a real device trace).
+
+    Use as a context manager around any training/contributivity region:
+        with utils.profile_trace("/tmp/mplc_trace"):
+            scenario.run()
+    No-op unless a directory is given or MPLC_TPU_PROFILE_DIR is set, so it
+    can be left in production code paths.
+    """
+
+    def __init__(self, trace_dir: str | None = None):
+        import os
+        self.trace_dir = trace_dir or os.environ.get("MPLC_TPU_PROFILE_DIR")
+
+    def __enter__(self):
+        if self.trace_dir:
+            import jax
+            jax.profiler.start_trace(self.trace_dir)
+        return self
+
+    def __exit__(self, *exc):
+        if self.trace_dir:
+            import jax
+            jax.profiler.stop_trace()
+        return False
+
+
 def set_log_file(path: Path):
     root = logging.getLogger("mplc_tpu")
     info_h = logging.FileHandler(Path(path) / constants.INFO_LOGGING_FILE_NAME)

@@ -22,6 +22,14 @@ os.environ.setdefault("MPLC_TPU_SYNTH_SCALE", "0.02")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent XLA compilation cache: the suite's cost is dominated by CPU
+# compiles of the conv models; cache them across pytest runs.
+from pathlib import Path  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  str(Path(__file__).resolve().parents[1] / ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -50,8 +58,8 @@ def quick_scenario(tiny_image_dataset):
     """A 3-partner fedavg scenario, split and ready to train."""
     from mplc_tpu.scenario import Scenario
     sc = Scenario(partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
-                  dataset=tiny_image_dataset, epoch_count=2, minibatch_count=2,
-                  gradient_updates_per_pass_count=2, is_early_stopping=False,
+                  dataset=tiny_image_dataset, epoch_count=4, minibatch_count=2,
+                  gradient_updates_per_pass_count=4, is_early_stopping=False,
                   experiment_path="/tmp/mplc_tpu_tests", seed=3)
     sc.instantiate_scenario_partners()
     sc.split_data(is_logging_enabled=False)

@@ -25,6 +25,7 @@ class FakeEngine(CharacteristicEngine):
     def __init__(self, n, value_fn):
         self.partners_count = n
         self.value_fn = value_fn
+        self.seed = 0
         self.charac_fct_values = {(): 0.0}
         self.increments_values = [dict() for _ in range(n)]
         self.first_charac_fct_calls_count = 0
@@ -33,6 +34,10 @@ class FakeEngine(CharacteristicEngine):
     def _run_batch(self, subsets, pipe=None):
         for s in subsets:
             self._store(s, float(self.value_fn(s)))
+
+    def _fingerprint(self):
+        return {"partners_count": self.partners_count, "seed": self.seed,
+                "fake_game": True}
 
     def evaluate(self, subsets):
         keys = [tuple(sorted(int(i) for i in s)) for s in subsets]
@@ -83,6 +88,30 @@ def test_exact_sv_additive_game():
     values = {s: sum(phi[i] for i in s) for s in powerset_order(n)}
     sv = shapley_from_characteristic(n, values)
     assert np.allclose(sv, phi, atol=1e-12)
+
+
+def test_exact_sv_matches_permutation_oracle():
+    """Parity oracle (SURVEY.md §4): the bit-twiddling SV must equal the
+    textbook average-over-all-permutations marginal computation on a random
+    characteristic function."""
+    from itertools import permutations
+    n = 5
+    rng = np.random.default_rng(123)
+    values = {s: float(rng.uniform()) for s in powerset_order(n)}
+    sv = shapley_from_characteristic(n, values)
+
+    def v(subset):
+        return values[tuple(sorted(subset))] if subset else 0.0
+
+    oracle = np.zeros(n)
+    perms = list(permutations(range(n)))
+    for perm in perms:
+        prefix = []
+        for i in perm:
+            oracle[i] += v(prefix + [i]) - v(prefix)
+            prefix.append(i)
+    oracle /= len(perms)
+    assert np.allclose(sv, oracle, atol=1e-12)
 
 
 def test_exact_sv_symmetric_game():
@@ -197,6 +226,35 @@ def test_engine_cache_shared_between_methods():
     c2.compute_independent_scores()
     # singletons were already cached by the SV sweep
     assert c2.first_charac_fct_calls_count == calls_after_sv
+
+
+def test_cache_save_load_roundtrip(tmp_path):
+    sc = fake_scenario(3, additive(PHI3))
+    c1 = Contributivity(sc)
+    c1.compute_SV()
+    path = tmp_path / "coalition_cache.json"
+    sc._charac_engine.save_cache(path)
+
+    sc2 = fake_scenario(3, additive(PHI3))
+    sc2._charac_engine.load_cache(path)
+    assert sc2._charac_engine.charac_fct_values == sc._charac_engine.charac_fct_values
+    assert sc2._charac_engine.increments_values == sc._charac_engine.increments_values
+    # a full SV sweep on the resumed engine trains nothing new
+    calls_before = sc2._charac_engine.first_charac_fct_calls_count
+    c2 = Contributivity(sc2)
+    c2.compute_SV()
+    assert sc2._charac_engine.first_charac_fct_calls_count == calls_before
+    assert np.allclose(c2.contributivity_scores, PHI3, atol=1e-9)
+
+
+def test_cache_load_rejects_mismatched_shape(tmp_path):
+    sc = fake_scenario(3, additive(PHI3))
+    Contributivity(sc).compute_SV()
+    path = tmp_path / "cache.json"
+    sc._charac_engine.save_cache(path)
+    sc4 = fake_scenario(4, additive([0.1, 0.2, 0.3, 0.4]))
+    with pytest.raises(ValueError):
+        sc4._charac_engine.load_cache(path)
 
 
 def test_kriging_model_interpolates():

@@ -153,7 +153,7 @@ def test_fedavg_class_runs(quick_scenario):
     assert mpl.learning_computation_time > 0
     hist = mpl.history
     assert hist.score == score
-    assert hist.history["mpl_model"]["val_loss"].shape == (2, 2)
+    assert hist.history["mpl_model"]["val_loss"].shape == (4, 2)
     df = hist.partners_to_dataframe()
     assert set(["Partner", "Epoch", "Minibatch"]).issubset(df.columns)
 

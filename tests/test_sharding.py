@@ -34,11 +34,27 @@ def test_entry_compiles():
     assert out.shape == (8, 10)
 
 
-def test_engine_shards_over_devices(quick_scenario):
+def _logreg_scenario():
+    """A 3-partner scenario on the titanic logistic model: the engine's
+    sharded pipeline compiles in seconds (the CNN-backed sharded path is
+    covered by the tiny-shape dryrun tests above)."""
+    from mplc_tpu.scenario import Scenario
+    sc = Scenario(partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+                  dataset_name="titanic", epoch_count=2, minibatch_count=2,
+                  gradient_updates_per_pass_count=2, is_early_stopping=False,
+                  experiment_path="/tmp/mplc_tpu_tests", seed=9)
+    sc.instantiate_scenario_partners()
+    sc.split_data(is_logging_enabled=False)
+    sc.compute_batch_sizes()
+    sc.data_corruption()
+    return sc
+
+
+def test_engine_shards_over_devices():
     """The characteristic engine must produce correct per-coalition scores
     when the mask batch is sharded over all 8 devices."""
     from mplc_tpu.contrib.engine import CharacteristicEngine
-    eng = CharacteristicEngine(quick_scenario)
+    eng = CharacteristicEngine(_logreg_scenario())
     assert eng._sharding is not None
     subsets = [(0,), (1,), (2,), (0, 1), (0, 2), (1, 2), (0, 1, 2)]
     vals = eng.evaluate(subsets)
