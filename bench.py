@@ -1,13 +1,21 @@
 #!/usr/bin/env python
-"""Benchmark: exact Shapley on MNIST-scale data, batched coalition sweep.
+"""Benchmark: exact Shapley on MNIST-scale data through the production
+characteristic-function engine.
 
 Workload (mirrors BASELINE.md configs[0] and the reference headline):
-MNIST-shaped dataset (60k train), 3 partners [0.4, 0.3, 0.3], basic random
-split, fedavg + data-volume aggregation, exact Shapley = all 2^3-1 = 7
-coalition trainings. The reference (saved_experiments results.csv) trains
-ONE such fedavg model in ~589 s wall-clock at 50 epochs; exact Shapley there
-costs 7 serialized trainings. Here all 7 coalitions train together as one
-vmapped (and, multi-chip, sharded) batch.
+MNIST-shaped dataset (60k train), BENCH_PARTNERS partners (default 3,
+amounts [0.4, 0.3, 0.3]), basic random split, fedavg + data-volume
+aggregation, exact Shapley = all 2^N-1 coalition trainings. The reference
+(saved_experiments results.csv) trains ONE such fedavg model in ~589 s
+wall-clock at 50 epochs; exact Shapley there costs 2^N-1 serialized
+trainings. Here the engine batches coalitions, groups them by size (a
+size-k coalition trains k partner slots, not N masked ones), and — with
+multiple devices — shards each batch over the `coal` mesh axis.
+
+Timing excludes compilation: a warm-up engine compiles and runs every
+program once (executables are shared per (model, config) via the trainer
+cache), then a fresh engine with an empty memo cache is timed end to end —
+the exact production path (reference loop: contributivity.py:149-158).
 
 Baseline accounting: reference wall-clock scales ~linearly in epochs, so
   baseline_seconds = 589 s * (epoch_count / 50) * n_coalitions
@@ -15,8 +23,9 @@ and vs_baseline = baseline_seconds / measured_seconds (higher is better).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Env knobs: BENCH_PARTNERS (default 3), BENCH_EPOCHS (default 8),
-BENCH_DTYPE (default bfloat16 on TPU, float32 on CPU),
-MPLC_TPU_SYNTH_SCALE for smaller data on CPU smoke runs.
+BENCH_DTYPE (default bfloat16 on TPU, float32 on CPU), MPLC_TPU_NO_SLOTS=1
+to fall back to masked full-width execution, MPLC_TPU_SYNTH_SCALE for
+smaller data on CPU smoke runs.
 """
 
 import json
@@ -30,18 +39,30 @@ REFERENCE_MNIST_FEDAVG_SECONDS = 589.0   # saved_experiments/.../results.csv mea
 REFERENCE_EPOCH_BUDGET = 50
 
 
+def _make_scenario(n_partners, epochs, dtype):
+    from mplc_tpu.data.datasets import load_mnist
+    from mplc_tpu.scenario import Scenario
+
+    amounts = [0.4, 0.3, 0.3] if n_partners == 3 else \
+        [1.0 / n_partners] * n_partners
+    amounts = [a / sum(amounts) for a in amounts]
+    sc = Scenario(partners_count=n_partners, amounts_per_partner=amounts,
+                  dataset=load_mnist(), multi_partner_learning_approach="fedavg",
+                  aggregation_weighting="data-volume", epoch_count=epochs,
+                  minibatch_count=10, gradient_updates_per_pass_count=8,
+                  is_early_stopping=False, compute_dtype=dtype,
+                  experiment_path="/tmp/mplc_bench", is_dry_run=True, seed=0)
+    sc.instantiate_scenario_partners()
+    sc.split_data(is_logging_enabled=False)
+    sc.compute_batch_sizes()
+    return sc
+
+
 def main():
     import jax
-    import jax.numpy as jnp
 
+    from mplc_tpu.contrib.engine import CharacteristicEngine
     from mplc_tpu.contrib.shapley import powerset_order, shapley_from_characteristic
-    from mplc_tpu.data.datasets import load_mnist
-    from mplc_tpu.data.partner import Partner
-    from mplc_tpu.data.partition import (StackedPartners, compute_batch_sizes,
-                                         split_basic, stack_eval_set)
-    from mplc_tpu.mpl.engine import EvalSet, MplTrainer, TrainConfig
-    from mplc_tpu.parallel.mesh import coalition_sharding
-    from mplc_tpu import constants
 
     n_partners = int(os.environ.get("BENCH_PARTNERS", "3"))
     epochs = int(os.environ.get("BENCH_EPOCHS", "8"))
@@ -52,69 +73,24 @@ def main():
     print(f"[bench] devices={jax.devices()} dtype={dtype} "
           f"partners={n_partners} epochs={epochs}", file=sys.stderr)
 
-    ds = load_mnist()
-    amounts = [0.4, 0.3, 0.3] if n_partners == 3 else \
-        [1.0 / n_partners] * n_partners
-    amounts = [a / sum(amounts) for a in amounts]
-    partners = [Partner(i) for i in range(n_partners)]
-    split_basic(ds, partners, amounts, "random", minibatch_count=10)
-    compute_batch_sizes(partners, 10, 8, constants.MAX_BATCH_SIZE)
-
-    stacked = StackedPartners.build(partners, 10)
-    val = EvalSet(*stack_eval_set(ds.x_val, ds.y_val, 10, 2048))
-    test = EvalSet(*stack_eval_set(ds.x_test, ds.y_test, 10, 2048))
-
-    cfg = TrainConfig(approach="fedavg", aggregator="data-volume",
-                      epoch_count=epochs, minibatch_count=10,
-                      gradient_updates_per_pass=8, is_early_stopping=False,
-                      record_partner_val=False, compute_dtype=dtype)
-    trainer = MplTrainer(ds.model, cfg)
-
     coalitions = powerset_order(n_partners)
     B = len(coalitions)
-    masks = np.zeros((B, n_partners), np.float32)
-    for i, s in enumerate(coalitions):
-        masks[i, list(s)] = 1.0
-    masks = jnp.asarray(masks)
-    rngs = jax.random.split(jax.random.PRNGKey(0), B)
 
-    sharding = coalition_sharding()
-    if sharding is not None and B % sharding.num_devices == 0:
-        masks = jax.device_put(masks, sharding.batch_sharding)
-        rngs = jax.device_put(rngs, sharding.batch_sharding)
-
-    binit = jax.jit(jax.vmap(lambda r: trainer.init_state(r, n_partners)))
-
-    def run_all_epochs(state, stacked, val, masks, rngs):
-        return jax.vmap(trainer.epoch_chunk,
-                        in_axes=(0, None, None, 0, 0, None))(
-            state, stacked, val, masks, rngs, epochs)
-
-    brun = jax.jit(run_all_epochs)
-    bfin = jax.jit(jax.vmap(trainer.finalize, in_axes=(0, None)))
-
-    # AOT-compile the exact executables used in the timed region (excluded
-    # from the measurement, like any production sweep where the executable
-    # is cached across the 2^N coalition batches), then execute once to warm
-    # any lazy runtime initialization.
-    state = binit(rngs)
-    brun_c = brun.lower(state, stacked, val, masks, rngs).compile()
-    bfin_c = bfin.lower(state, test).compile()
-    warm = bfin_c(brun_c(state, stacked, val, masks, rngs), test)
-    np.asarray(warm[1])
+    # Warm-up: compile + run every (size-group) program once. The compiled
+    # executables live on the shared per-(model, config) trainers, so the
+    # timed engine below reuses them with a cold memo cache.
+    sc = _make_scenario(n_partners, epochs, dtype)
+    warm = CharacteristicEngine(sc)
+    warm.evaluate(coalitions)
     print("[bench] compiled; timing...", file=sys.stderr)
 
-    # Time until the scores are on the host: a host fetch is the only sync
-    # that every backend (incl. the tunneled axon TPU) honors.
+    timed_engine = CharacteristicEngine(sc)
     t0 = time.perf_counter()
-    state = binit(rngs)
-    state = brun_c(state, stacked, val, masks, rngs)
-    losses, accs = bfin_c(state, test)
-    accs = np.asarray(accs)
+    accs = timed_engine.evaluate(coalitions)   # engine fetches scores to host
     elapsed = time.perf_counter() - t0
+    assert timed_engine.first_charac_fct_calls_count == B
 
     values = {(): 0.0}
-    accs = np.asarray(accs)
     for s, a in zip(coalitions, accs):
         values[s] = float(a)
     sv = shapley_from_characteristic(n_partners, values)

@@ -79,6 +79,14 @@ class TrainConfig:
     # approaches (fedavg/lflip) can shard partners; seq-* visit partners
     # serially and `single` reduces to one partner.
     partner_axis: str | None = None
+    # Slot execution (coalition sweeps): instead of running all P partners
+    # with inactive ones masked to zero — wasting |P|-|S| partners' worth of
+    # compute per coalition — run exactly `slot_count` slots, each bound at
+    # runtime to a partner index. The coalition argument becomes an int32
+    # id array [slot_count] (pad with -1) instead of a float mask [P].
+    # fedavg only; RNG streams are keyed by partner id, so slotted and
+    # masked runs train identically.
+    slot_count: int | None = None
 
     def __post_init__(self):
         if self.approach not in APPROACH_NAMES:
@@ -89,6 +97,12 @@ class TrainConfig:
             raise ValueError(
                 f"partner-axis sharding requires a partner-parallel approach "
                 f"(fedavg/lflip), got '{self.approach}'")
+        if self.slot_count is not None:
+            if self.approach != "fedavg":
+                raise ValueError("slot execution supports fedavg only")
+            if self.partner_axis is not None:
+                raise ValueError("slot execution and partner-axis sharding "
+                                 "are mutually exclusive")
 
     @property
     def dtype(self):
@@ -311,15 +325,19 @@ class MplTrainer:
 
     def _partner_pass(self, start_params, x_p, y_p, perm_p, size_p, active_p,
                       mb_i, rng_p, opt_state=None, y_override=None,
-                      window_idx=None):
+                      window_idx=None, row_offset=0, n_max=None):
         """Run `gup` masked SGD steps for one partner on minibatch mb_i.
 
         If `y_override`/`window_idx` are given (lflip), steps slice rows from
         that pre-gathered minibatch window instead of the raw arrays.
+        Slot execution passes the FLAT [P*Nmax, ...] arrays as x_p/y_p with
+        `row_offset = partner_id * Nmax` (one fused gather, no per-slot copy)
+        and `n_max` = Nmax explicitly.
         Returns (params, opt_state, pass_loss, pass_acc).
         """
         cfg = self.cfg
-        n_max = x_p.shape[0]
+        if n_max is None:
+            n_max = x_p.shape[0]
         mb_cap = max(n_max // cfg.minibatch_count, 1)
         sb_cap = (mb_cap + cfg.gradient_updates_per_pass - 1) // cfg.gradient_updates_per_pass
         fresh = opt_state is None
@@ -339,8 +357,8 @@ class MplTrainer:
                 x = jnp.take(x_p, jnp.take(window_idx, local, axis=0), axis=0)
                 y = jnp.take(y_override, local, axis=0)
             else:
-                x = jnp.take(x_p, idx, axis=0)
-                y = jnp.take(y_p, idx, axis=0)
+                x = jnp.take(x_p, idx + row_offset, axis=0)
+                y = jnp.take(y_p, idx + row_offset, axis=0)
             m = valid * active_p
             step_rng = jax.random.fold_in(rng_p, g)
             params, opt_state, loss, acc, cnt = self._sgd_step(
@@ -464,6 +482,79 @@ class MplTrainer:
             jnp.arange(cfg.minibatch_count))
         return state._replace(params=params, theta=theta, val_loss_h=vl_h,
                               val_acc_h=va_h, partner_h=p_h)
+
+    def _fedavg_slot_epoch(self, state: TrainState, stacked, val: EvalSet,
+                           active_ids, rng) -> TrainState:
+        """fedavg epoch over `slot_count` partner slots instead of all P
+        partners: a size-k coalition costs k partner passes, not P. Slot s
+        binds to partner `active_ids[s]` (-1 = unused slot); data rows come
+        from one fused gather into the flat [P*Nmax, ...] view. RNG streams
+        are keyed by partner id, so results equal the masked path exactly."""
+        cfg = self.cfg
+        e = state.epoch
+        P, n_max = stacked.x.shape[0], stacked.x.shape[1]
+
+        ids = active_ids.astype(jnp.int32)            # [K]
+        active = (ids >= 0).astype(jnp.float32)       # [K]
+        pids = jnp.maximum(ids, 0)                    # [K] safe partner rows
+
+        flat_x = stacked.x.reshape((P * n_max,) + stacked.x.shape[2:])
+        flat_y = stacked.y.reshape((P * n_max,) + stacked.y.shape[2:])
+        slot_sizes = jnp.take(stacked.sizes, pids, axis=0)          # [K]
+        slot_mask_rows = jnp.take(stacked.mask, pids, axis=0)       # [K, Nmax]
+
+        # per-slot epoch permutation, keyed by GLOBAL partner id (identical
+        # stream to the masked path's _epoch_perms)
+        rng_perm = jax.random.fold_in(rng, 0)
+
+        def perm_of(pid, mask_row):
+            keys = jax.random.uniform(jax.random.fold_in(rng_perm, pid),
+                                      mask_row.shape) + (1.0 - mask_row) * 1e9
+            return jnp.argsort(keys).astype(jnp.int32)
+
+        perms = jax.vmap(perm_of)(pids, slot_mask_rows)             # [K, Nmax]
+
+        def mb_body(carry, mb_i):
+            params, vl_h, va_h, p_h = carry
+            vl, va = self.evaluate(params, val)
+            vl_h = vl_h.at[e, mb_i].set(vl)
+            va_h = va_h.at[e, mb_i].set(va)
+
+            rng_mb = jax.random.fold_in(jax.random.fold_in(rng, 1), mb_i)
+
+            def one(pid, act, perm_p, size_p):
+                r = jax.random.fold_in(rng_mb, pid)
+                p, _, ls, ac = self._partner_pass(
+                    params, flat_x, flat_y, perm_p, size_p, act, mb_i, r,
+                    row_offset=pid * n_max, n_max=n_max)
+                return p, ls, ac
+
+            new_params, losses, accs = jax.vmap(one)(pids, active, perms,
+                                                     slot_sizes)
+
+            need_pval = cfg.record_partner_val or cfg.aggregator == "local-score"
+            if need_pval:
+                pvl, pva = jax.vmap(lambda pp: self.evaluate(pp, val))(new_params)
+            else:
+                pvl = jnp.full(ids.shape, jnp.nan)
+                pva = jnp.full(ids.shape, jnp.nan)
+            # scatter slot metrics into the [P]-indexed history; unused
+            # slots are dropped via an out-of-bounds row
+            scatter_rows = jnp.where(ids >= 0, pids, P)
+            p_h = p_h.at[:, scatter_rows, e, mb_i].set(
+                jnp.stack([losses, accs, pvl, pva]), mode="drop")
+
+            w = aggregation_weights(cfg.aggregator, active, slot_sizes,
+                                    jnp.nan_to_num(pva))
+            params = aggregate(new_params, w)
+            return (params, vl_h, va_h, p_h), None
+
+        (params, vl_h, va_h, p_h), _ = lax.scan(
+            mb_body, (state.params, state.val_loss_h, state.val_acc_h,
+                      state.partner_h),
+            jnp.arange(cfg.minibatch_count))
+        return state._replace(params=params, val_loss_h=vl_h, val_acc_h=va_h,
+                              partner_h=p_h)
 
     def _seq_epoch(self, state: TrainState, stacked, val: EvalSet,
                    coal_mask, rng) -> TrainState:
@@ -604,7 +695,9 @@ class MplTrainer:
         """One epoch with done-freezing; safe inside scan/vmap."""
         cfg = self.cfg
         rng = jax.random.fold_in(rng, state.epoch)
-        if cfg.approach in ("fedavg", "lflip"):
+        if cfg.slot_count is not None:
+            new = self._fedavg_slot_epoch(state, stacked, val, coal_mask, rng)
+        elif cfg.approach in ("fedavg", "lflip"):
             new = self._fedavg_epoch(state, stacked, val, coal_mask, rng)
         elif cfg.approach == "single":
             new = self._single_epoch(state, stacked, val, coal_mask, rng)

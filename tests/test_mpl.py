@@ -139,6 +139,48 @@ def test_history_matrices_filled(small_logreg_problem):
     assert not np.isnan(ph).any()
 
 
+@pytest.mark.parametrize("slot_count,ids", [(2, [0, 2]), (3, [0, 2, -1])])
+def test_slot_execution_matches_masked(small_logreg_problem, slot_count, ids):
+    """A size-2 coalition of 3 partners trained via 2 (or 3, one padded)
+    slots must produce bit-identical training to the masked path — RNG
+    streams are keyed by partner id in both."""
+    stacked, val, test = small_logreg_problem
+    base = dict(approach="fedavg", aggregator="data-volume", epoch_count=2,
+                minibatch_count=2, gradient_updates_per_pass=2,
+                is_early_stopping=False, record_partner_val=True)
+    tr_mask = MplTrainer(TITANIC_LOGREG, TrainConfig(**base))
+    tr_slot = MplTrainer(TITANIC_LOGREG, TrainConfig(slot_count=slot_count, **base))
+    rng = jax.random.PRNGKey(4)
+
+    run_m = jax.jit(tr_mask.epoch_chunk, static_argnames=("n_epochs",))
+    s1 = run_m(tr_mask.init_state(rng, 3), stacked, val,
+               jnp.array([1., 0., 1.]), rng, n_epochs=2)
+    run_s = jax.jit(tr_slot.epoch_chunk, static_argnames=("n_epochs",))
+    s2 = run_s(tr_slot.init_state(rng, 3), stacked, val,
+               jnp.array(ids, jnp.int32), rng, n_epochs=2)
+
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s2.params)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    assert np.allclose(np.asarray(s1.val_loss_h), np.asarray(s2.val_loss_h),
+                       atol=1e-5)
+    # active partners' history rows match; unused slot rows stay NaN
+    ph1, ph2 = np.asarray(s1.partner_h), np.asarray(s2.partner_h)
+    for p in (0, 2):
+        assert np.allclose(ph1[:, p], ph2[:, p], atol=1e-5)
+    assert np.isnan(ph2[:, 1]).all()
+    _, a1 = jax.jit(tr_mask.finalize)(s1, test)
+    _, a2 = jax.jit(tr_slot.finalize)(s2, test)
+    assert np.isclose(float(a1), float(a2), atol=1e-6)
+
+
+def test_slot_config_guards():
+    with pytest.raises(ValueError):
+        TrainConfig(approach="seqavg", slot_count=2)
+    with pytest.raises(ValueError):
+        TrainConfig(approach="fedavg", slot_count=2, partner_axis="part")
+
+
 # -- approach classes over a real scenario ----------------------------------
 
 def test_registry_keys():
