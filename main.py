@@ -35,6 +35,19 @@ def validate_scenario_list(scenario_params_list, experiment_path):
 
 
 def main(argv=None):
+    """Top-level error capture (reference wraps main in @logger.catch,
+    main.py:21): any crash in a multi-hour grid is logged WITH traceback to
+    the experiment folder's log files before the process exits nonzero."""
+    try:
+        return _main(argv)
+    except SystemExit:
+        raise
+    except BaseException:
+        utils.logger.exception("Experiment run crashed:")
+        return 1
+
+
+def _main(argv=None):
     args = parse_command_line_arguments(argv)
     logger = utils.init_logger(debug=args.verbose)
 

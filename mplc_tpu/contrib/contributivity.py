@@ -673,6 +673,9 @@ class Contributivity:
             is_early_stopping=False,
             compute_dtype=getattr(sc, "compute_dtype", "float32"),
             record_partner_val=False,
+            # the reward is computed from a direct end-of-epoch eval below;
+            # no per-minibatch val history needed
+            record_val_history=False,
         )
         trainer = MplTrainer(sc.dataset.model, cfg)
         rng = jax.random.PRNGKey(getattr(sc, "seed", 0) + 99)
@@ -690,7 +693,10 @@ class Contributivity:
             mask = jnp.asarray(is_in, jnp.float32)
             state = run(state, eng.stacked, eng.val, mask,
                         jax.random.fold_in(rng, epoch), n_epochs=1)
-            loss = float(np.asarray(state.val_loss_h)[epoch, sc.minibatch_count - 1])
+            # reward from the END-of-epoch model (a fresh eval of the
+            # current params) — the [epoch, MB-1] history cell is recorded
+            # at the START of the last minibatch and lags one aggregation
+            loss = float(ev(state.params, eng.val)[0])
             G = -loss + prev_loss
             dp_dw = np.exp(w) / (1 + np.exp(w)) ** 2
             prodp = np.prod(values)

@@ -115,6 +115,9 @@ class CharacteristicEngine:
             is_early_stopping=True,
             compute_dtype=getattr(scenario, "compute_dtype", "float32"),
             record_partner_val=False,
+            # coalition sweeps never read the per-minibatch val history;
+            # only the one early-stopping column per epoch is evaluated
+            record_val_history=False,
         )
         multi_cfg = TrainConfig(approach=scenario.multi_partner_learning_approach_key,
                                 **base)
@@ -134,6 +137,10 @@ class CharacteristicEngine:
         self.charac_fct_values: dict[tuple, float] = {(): 0.0}
         self.increments_values = [dict() for _ in range(self.partners_count)]
         self.first_charac_fct_calls_count = 0
+        # When set, the memo cache is persisted after EVERY device batch, so
+        # a crash mid-sweep loses at most one batch of trained coalitions
+        # (the reference loses everything — it checkpoints nothing).
+        self.autosave_path = None
 
         self._sharding = coalition_sharding()
 
@@ -141,11 +148,55 @@ class CharacteristicEngine:
 
     def _coalition_rng(self, subset: tuple) -> jax.Array:
         """Deterministic per-coalition rng, independent of batch composition
-        — same coalition always trains identically."""
+        — same coalition always trains identically. The membership bitmask
+        is folded in 32-bit words so partner counts >= 32 don't overflow
+        fold_in's uint32 operand (identical stream to the single fold for
+        < 32 partners: the loop runs once)."""
         bits = 0
         for i in subset:
             bits |= 1 << int(i)
-        return jax.random.fold_in(jax.random.PRNGKey(self.seed), bits)
+        key = jax.random.PRNGKey(self.seed)
+        while True:
+            key = jax.random.fold_in(key, bits & 0xFFFFFFFF)
+            bits >>= 32
+            if not bits:
+                return key
+
+    def _device_batch_cap(self, slot_count: int | None = None) -> int:
+        """Coalitions per device per compiled batch.
+
+        Ceiling = constants.MAX_COALITIONS_PER_DEVICE_BATCH (16): larger
+        power-of-two buckets would each compile their own program per slot
+        size, exploding compile time for marginal dispatch savings. The cap
+        autotunes DOWN when the per-coalition HBM footprint (params x
+        (1 global + slots trained in flight + adam moments + grads) plus the
+        eval-chunk activation window) would overflow ~50% of device memory.
+        Override with MPLC_TPU_COALITIONS_PER_DEVICE.
+        """
+        env = os.environ.get("MPLC_TPU_COALITIONS_PER_DEVICE")
+        if env:
+            return max(1, int(env))
+        if getattr(self, "_param_bytes", None) is None:
+            shapes = jax.eval_shape(self.model.init, jax.random.PRNGKey(0))
+            self._param_bytes = sum(
+                int(np.prod(l.shape)) * l.dtype.itemsize
+                for l in jax.tree_util.tree_leaves(shapes))
+        k = slot_count if slot_count is not None else self.partners_count
+        # params + k slot copies + 2 adam moments per slot + grad workspace
+        per_coal = self._param_bytes * (4 * k + 4)
+        # activation window: eval chunk + training sub-batch, fudge x8 for
+        # conv intermediates
+        sample_bytes = int(np.prod(self.stacked.x.shape[2:])) * 4
+        per_coal += 8 * sample_bytes * max(
+            constants.EVAL_CHUNK_SIZE,
+            self.stacked.x.shape[1] // max(1, self.multi_pipe.trainer.cfg.minibatch_count))
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            hbm = int(stats.get("bytes_limit", 8 << 30))
+        except Exception:
+            hbm = 8 << 30
+        fit = max(1, int(0.5 * hbm / max(per_coal, 1)))
+        return min(constants.MAX_COALITIONS_PER_DEVICE_BATCH, fit)
 
     def _slot_pipe(self, k: int) -> BatchedTrainerPipeline:
         if k not in self._slot_pipes:
@@ -157,12 +208,16 @@ class CharacteristicEngine:
     def _run_batch(self, subsets: list[tuple], pipe: BatchedTrainerPipeline,
                    slot_count: int | None = None) -> None:
         n_dev = max(self._sharding.num_devices if self._sharding else 1, 1)
-        cap = constants.MAX_COALITIONS_PER_DEVICE_BATCH
+        cap = self._device_batch_cap(slot_count)
+        # ONE bucket width for the whole call (the tail group pads up to it
+        # rather than compiling its own smaller-width program) — so a warm-up
+        # pass over min(len, n_dev*cap) subsets per size compiles exactly
+        # the programs a full sweep executes.
+        b = _bucket_size(min(len(subsets), n_dev * cap), n_dev, cap)
         i = 0
         while i < len(subsets):
-            group = subsets[i:i + n_dev * cap]
+            group = subsets[i:i + b]
             i += len(group)
-            b = _bucket_size(len(group), n_dev, cap)
             padded = list(group) + [group[0]] * (b - len(group))
             if slot_count is not None:
                 coal = np.full((b, slot_count), -1, np.int32)
@@ -181,6 +236,8 @@ class CharacteristicEngine:
                                self._coalition_rng(()))
             for s, acc in zip(group, accs[:len(group)]):
                 self._store(s, float(acc))
+            if self.autosave_path is not None:
+                self.save_cache(self.autosave_path)
 
     def _store(self, subset: tuple, value: float) -> None:
         self.charac_fct_values[subset] = value
@@ -242,27 +299,68 @@ class CharacteristicEngine:
     # is the improvement its structure invites (SURVEY.md §5).
     # ------------------------------------------------------------------
 
+    def _data_digest(self) -> str:
+        """Content hash of the actual training/eval device arrays. Subsumes
+        every upstream data decision — split type, proportions, corruption,
+        dataset_proportion, seeds — because any of them changes the bytes.
+        x arrays are sampled with a stride to keep hashing cheap; labels and
+        masks are hashed in full (corruption only touches y)."""
+        if getattr(self, "_digest_cache", None) is not None:
+            return self._digest_cache
+        import hashlib
+        h = hashlib.sha256()
+
+        def add(arr, stride_cap_bytes=1 << 22):
+            a = np.ascontiguousarray(np.asarray(arr))
+            h.update(str(a.shape).encode())
+            # stride over FLAT elements so sampling is uniform across the
+            # whole array (striding axis 0 would only ever hash partner 0)
+            flat = a.reshape(-1)
+            stride = max(1, flat.nbytes // stride_cap_bytes)
+            h.update(np.ascontiguousarray(flat[::stride]).tobytes())
+
+        add(self.stacked.x)
+        add(self.stacked.y, stride_cap_bytes=1 << 30)   # full labels
+        add(self.stacked.sizes, stride_cap_bytes=1 << 30)
+        add(self.val.x)
+        add(self.val.y, stride_cap_bytes=1 << 30)
+        add(self.test.x)
+        add(self.test.y, stride_cap_bytes=1 << 30)
+        self._digest_cache = h.hexdigest()[:16]
+        return self._digest_cache
+
     def _fingerprint(self) -> dict:
         """Everything v(S) depends on: a cache from a run with a different
         value for any of these would describe a different game."""
         cfg = self.multi_pipe.trainer.cfg
+        sc = self.scenario
         return {
             "partners_count": self.partners_count,
             "seed": self.seed,
-            "dataset": getattr(self.scenario.dataset, "name", "?"),
+            "dataset": getattr(sc.dataset, "name", "?"),
             "model": self.model.name,
             "approach": cfg.approach,
             "aggregator": cfg.aggregator,
             "epoch_count": cfg.epoch_count,
             "minibatch_count": cfg.minibatch_count,
             "gradient_updates_per_pass": cfg.gradient_updates_per_pass,
+            "compute_dtype": cfg.compute_dtype,
+            "split": [str(getattr(sc, "samples_split_type", "?")),
+                      str(getattr(sc, "samples_split_description", "?"))],
+            "corruption": [str(c) for c in
+                           getattr(sc, "corrupted_datasets",
+                                   ["not_corrupted"] * self.partners_count)],
             "partner_sizes": [int(s) for s in
                               np.asarray(self.stacked.sizes).tolist()],
+            "data_digest": self._data_digest(),
         }
 
     def save_cache(self, path) -> None:
-        """Persist v(S) memo + increment bookkeeping as JSON."""
+        """Persist v(S) memo + increment bookkeeping as JSON (atomic:
+        write-to-temp + rename, so an interrupted autosave never corrupts a
+        previously good cache file)."""
         import json
+        import os as _os
         payload = {
             "fingerprint": self._fingerprint(),
             "first_charac_fct_calls_count": self.first_charac_fct_calls_count,
@@ -271,8 +369,10 @@ class CharacteristicEngine:
             "increments_values": [[[list(k), v] for k, v in d.items()]
                                   for d in self.increments_values],
         }
-        with open(path, "w") as f:
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
             json.dump(payload, f)
+        _os.replace(tmp, path)
 
     def load_cache(self, path) -> None:
         """Restore a saved cache; a cache from a scenario whose training

@@ -133,6 +133,14 @@ def _synth_scale() -> float:
     return float(os.environ.get("MPLC_TPU_SYNTH_SCALE", "1.0"))
 
 
+def _synth_noise(default: float) -> float:
+    """Noise level for the synthetic image datasets. Raising it keeps the
+    task learnable but stops accuracy saturating at 1.0, so coalition
+    scores — and therefore Shapley values — actually differ (bench.py sets
+    this; the quick test fixtures keep the easier default)."""
+    return float(os.environ.get("MPLC_TPU_SYNTH_NOISE", str(default)))
+
+
 def synthetic_image_classification(rng: np.random.Generator, n: int,
                                    shape: tuple, num_classes: int,
                                    signal: float = 1.0, noise: float = 0.35
@@ -166,6 +174,79 @@ def _digits_prototypes() -> np.ndarray | None:
     return protos
 
 
+# -- raw-data featurization --------------------------------------------------
+
+def featurize_titanic_csv(csv_path) -> tuple[np.ndarray, np.ndarray]:
+    """Engineer the 27 model features from a raw Stanford-CS109-format
+    Titanic CSV (columns: Survived, Pclass, Name, Sex, Age,
+    Siblings/Spouses Aboard, Parents/Children Aboard, Fare).
+
+    Reference semantics (/root/reference/mplc/dataset.py:237-258): family
+    size, name length, is-alone and a sex flag are derived; passenger class
+    and the honorific (first word of the name) are one-hot encoded; Age and
+    Fare stay numeric. Two deliberate fixes over the reference: the sex
+    comparison is case-insensitive (upstream compares against "Male" while
+    the CSV says "male", zeroing the column), and the honorific one-hot is
+    pinned to the 18 most frequent titles so the output width is always
+    exactly TITANIC_NUM_FEATURES regardless of CSV contents.
+    """
+    import pandas as pd
+    df = pd.read_csv(csv_path, index_col=False)
+    if df.columns[0].startswith("Unnamed"):
+        df = df.drop(columns=df.columns[0])
+    y = df["Survived"].to_numpy(np.float32)
+
+    sibs = df["Siblings/Spouses Aboard"].to_numpy(np.float32)
+    parch = df["Parents/Children Aboard"].to_numpy(np.float32)
+    fam_size = sibs + parch
+    cols = [
+        df["Sex"].str.lower().eq("male").to_numpy(np.float32),
+        df["Age"].to_numpy(np.float32),
+        df["Fare"].to_numpy(np.float32),
+        fam_size,
+        df["Name"].str.len().to_numpy(np.float32),
+        (fam_size == 0).astype(np.float32),
+    ]
+    for pclass in (1, 2, 3):
+        cols.append(df["Pclass"].eq(pclass).to_numpy(np.float32))
+
+    titles = df["Name"].str.split().str[0]
+    n_title_cols = model_zoo.TITANIC_NUM_FEATURES - len(cols)
+    counts = titles.value_counts()
+    kept = sorted(counts.index[:n_title_cols])
+    for t in kept:
+        cols.append(titles.eq(t).to_numpy(np.float32))
+    while len(cols) < model_zoo.TITANIC_NUM_FEATURES:
+        cols.append(np.zeros(len(df), np.float32))
+
+    x = np.stack(cols, axis=1).astype(np.float32)
+    return np.nan_to_num(x), y
+
+
+def load_esc50_raw(folder) -> tuple[np.ndarray, np.ndarray]:
+    """MFCC featurization of a raw ESC-50 checkout: `<folder>/esc50.csv`
+    (filename + target columns) and `<folder>/audio/*.wav`. Each clip
+    becomes a [40, 431, 1] MFCC image (reference mplc/dataset.py:604-617;
+    MFCCs computed by mplc_tpu.data.audio, librosa-default parameters).
+    """
+    import pandas as pd
+    from .audio import load_wav, mfcc
+
+    folder = Path(folder)
+    df = pd.read_csv(folder / "esc50.csv")
+    feats, ys = [], []
+    for fname, target in zip(df["filename"], df["target"]):
+        samples, sr = load_wav(folder / "audio" / fname)
+        m = mfcc(samples, sr, n_mfcc=40)
+        # pin the frame axis to the model's 431 (5 s @ 44.1 kHz / hop 512)
+        if m.shape[1] < 431:
+            m = np.pad(m, ((0, 0), (0, 431 - m.shape[1])))
+        feats.append(m[:, :431])
+        ys.append(int(target))
+    x = np.stack(feats).astype(np.float32)[..., None]
+    return x, np.asarray(ys, np.int64)
+
+
 # -- per-dataset loaders -----------------------------------------------------
 
 def load_mnist() -> Dataset:
@@ -189,13 +270,16 @@ def load_mnist() -> Dataset:
                 # noise high enough that accuracy does not saturate at 1.0 —
                 # coalition scores must differ for Shapley values to be
                 # informative (and for the contributivity ordering oracle).
-                x = protos[y][..., None] + rng.normal(0, 0.45, size=(len(y), 28, 28, 1))
+                x = protos[y][..., None] + rng.normal(
+                    0, _synth_noise(0.45), size=(len(y), 28, 28, 1))
                 return np.clip(x, 0, 1).astype(np.float32)
             x_train, x_test = make(y_train), make(y_test)
             prov = "synthetic:sklearn-digits-prototypes"
         else:
-            x_train, y_train = synthetic_image_classification(rng, n_train, (28, 28, 1), 10)
-            x_test, y_test = synthetic_image_classification(rng, n_test, (28, 28, 1), 10)
+            x_train, y_train = synthetic_image_classification(
+                rng, n_train, (28, 28, 1), 10, noise=_synth_noise(0.35))
+            x_test, y_test = synthetic_image_classification(
+                rng, n_test, (28, 28, 1), 10, noise=_synth_noise(0.35))
             prov = "synthetic:prototype-noise"
     return Dataset(constants.MNIST, (28, 28, 1), 10,
                    x_train, to_categorical(y_train, 10),
@@ -217,9 +301,11 @@ def load_cifar10() -> Dataset:
         n_train = int(50000 * _synth_scale())
         n_test = int(10000 * _synth_scale())
         x_train, y_train = synthetic_image_classification(rng, n_train, (32, 32, 3), 10,
-                                                          signal=0.8, noise=0.45)
+                                                          signal=0.8,
+                                                          noise=_synth_noise(0.45))
         x_test, y_test = synthetic_image_classification(rng, n_test, (32, 32, 3), 10,
-                                                        signal=0.8, noise=0.45)
+                                                        signal=0.8,
+                                                        noise=_synth_noise(0.45))
         prov = "synthetic:prototype-noise"
     return Dataset(constants.CIFAR10, (32, 32, 3), 10,
                    x_train, to_categorical(y_train, 10),
@@ -242,10 +328,14 @@ class TitanicDataset(Dataset):
 
 def load_titanic() -> Dataset:
     cache = _find_cache("titanic.npz")
+    raw = _find_cache("titanic.csv", "titanic/titanic.csv")
     if cache is not None:
         with np.load(cache, allow_pickle=True) as f:
             x, y = f["x"].astype(np.float32), f["y"].astype(np.float32)
         prov = f"cache:{cache}"
+    elif raw is not None:
+        x, y = featurize_titanic_csv(raw)
+        prov = f"raw:{raw}"
     else:
         # Synthetic 27-feature tabular data with a planted logistic rule
         # (reference preprocesses the Kaggle CSV into 27 one-hot/numeric
@@ -307,10 +397,18 @@ def load_imdb() -> Dataset:
 
 def load_esc50() -> Dataset:
     cache = _find_cache("esc50.npz")
+    raw = None
+    for d in _cache_dirs():
+        if (d / "esc50" / "esc50.csv").exists() and (d / "esc50" / "audio").is_dir():
+            raw = d / "esc50"
+            break
     if cache is not None:
         with np.load(cache, allow_pickle=True) as f:
             x, y = f["x"].astype(np.float32), f["y"]
         prov = f"cache:{cache}"
+    elif raw is not None:
+        x, y = load_esc50_raw(raw)
+        prov = f"raw:{raw}"
     else:
         rng = np.random.default_rng(46)
         n = int(2000 * max(_synth_scale(), 0.25))

@@ -153,9 +153,15 @@ class MultiPartnerLearning:
             state.val_loss_h, state.val_acc_h, state.partner_h,
             int(jax.device_get(state.nb_epochs_done)), float(test_acc))
         if self.approach_key == "lflip" and state.theta.size:
-            theta = np.asarray(state.theta)
-            self.history.theta = [[theta[i] for i in range(self.partners_count)]
-                                  for _ in range(max(self.epoch_index, 1))]
+            # Real per-epoch snapshots from the device-side [E, P, K, K]
+            # history; epochs never run (early stop) stay None, matching the
+            # reference's pre-filled list (multi_partner_learning.py:442).
+            theta_h = np.asarray(state.theta_h)
+            done = int(jax.device_get(state.nb_epochs_done))
+            self.history.theta = [
+                [theta_h[e, i] for i in range(self.partners_count)]
+                if e < done else [None] * self.partners_count
+                for e in range(self.epoch_count)]
         if self.is_save_data:
             self.save_final_model()
             self.history.save_data()

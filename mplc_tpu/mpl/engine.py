@@ -73,6 +73,12 @@ class TrainConfig:
     patience: int = constants.PATIENCE
     compute_dtype: str = "float32"
     record_partner_val: bool = True
+    # Record the global val loss/acc at the start of EVERY minibatch
+    # (reference multi_partner_learning.py:314). Early stopping only reads
+    # one column per epoch (0 for fedavg-family, MB-1 for seq-family), so
+    # coalition sweeps turn this off and pay one val pass per epoch — or
+    # zero when early stopping is off too — instead of `minibatch_count`.
+    record_val_history: bool = True
     lflip_epsilon: float = 0.01
     # Name of the mesh axis the partner dimension is sharded over (shard_map);
     # None = all partners resident on each device. Only the vmap-parallel
@@ -115,6 +121,8 @@ class TrainState(NamedTuple):
     params: Any              # global model params pytree
     opt_state: Any           # persistent optimizer state ('single' only; else empty)
     theta: jax.Array         # [P, K, K] label-flip matrices (lflip only; else [0])
+    theta_h: jax.Array       # [E, P, K, K] end-of-epoch theta (lflip only; else [0])
+                             # (reference history.theta, multi_partner_learning.py:482-484)
     epoch: jax.Array         # i32 scalar: next epoch index
     done: jax.Array          # bool scalar: early-stopped
     nb_epochs_done: jax.Array  # i32 scalar
@@ -223,16 +231,18 @@ class MplTrainer:
             opt_state = self.opt.init(params)
         else:
             opt_state = ()
+        E, MB = cfg.epoch_count, cfg.minibatch_count
         if cfg.approach == "lflip":
             k = self.model.num_outputs
             eye = jnp.eye(k)
             theta0 = eye * (1 - cfg.lflip_epsilon) + (1 - eye) * (cfg.lflip_epsilon / (k - 1))
             theta = jnp.broadcast_to(theta0, (partners_count, k, k))
+            theta_h = jnp.full((E, partners_count, k, k), jnp.nan, jnp.float32)
         else:
             theta = jnp.zeros((0,))
-        E, MB = cfg.epoch_count, cfg.minibatch_count
+            theta_h = jnp.zeros((0,))
         return TrainState(
-            params=params, opt_state=opt_state, theta=theta,
+            params=params, opt_state=opt_state, theta=theta, theta_h=theta_h,
             epoch=jnp.zeros((), jnp.int32), done=jnp.zeros((), bool),
             nb_epochs_done=jnp.zeros((), jnp.int32),
             best_val_loss=jnp.full((), jnp.inf, jnp.float32),
@@ -261,6 +271,26 @@ class MplTrainer:
         (ls, cs, cnt), _ = lax.scan(body, (0.0, 0.0, 0.0), (ev.x, ev.y, ev.mask))
         denom = jnp.maximum(cnt, 1.0)
         return ls / denom, cs / denom
+
+    def _maybe_val_eval(self, params, val: EvalSet, mb_i, es_col: int):
+        """Global val (loss, acc) at the start of minibatch `mb_i`, honoring
+        `record_val_history`: when off, only the minibatch column early
+        stopping reads (`es_col`) is evaluated — `mb_i` is a scan index,
+        unbatched under the coalition vmap, so the `lax.cond` is a real
+        branch and the skipped val passes never execute — and when early
+        stopping is off too, none are."""
+        cfg = self.cfg
+
+        def run():
+            vl, va = self.evaluate(params, val)
+            return jnp.asarray(vl, jnp.float32), jnp.asarray(va, jnp.float32)
+
+        if cfg.record_val_history:
+            return run()
+        nan = jnp.full((), jnp.nan, jnp.float32)
+        if cfg.is_early_stopping:
+            return lax.cond(mb_i == es_col, run, lambda: (nan, nan))
+        return nan, nan
 
     # ------------------------------------------------------------------
     # data selection helpers (all static shapes)
@@ -432,7 +462,7 @@ class MplTrainer:
 
         def mb_body(carry, mb_i):
             params, theta, vl_h, va_h, p_h = carry
-            vl, va = self.evaluate(params, val)
+            vl, va = self._maybe_val_eval(params, val, mb_i, es_col=0)
             vl_h = vl_h.at[e, mb_i].set(vl)
             va_h = va_h.at[e, mb_i].set(va)
 
@@ -516,7 +546,7 @@ class MplTrainer:
 
         def mb_body(carry, mb_i):
             params, vl_h, va_h, p_h = carry
-            vl, va = self.evaluate(params, val)
+            vl, va = self._maybe_val_eval(params, val, mb_i, es_col=0)
             vl_h = vl_h.at[e, mb_i].set(vl)
             va_h = va_h.at[e, mb_i].set(va)
 
@@ -566,7 +596,8 @@ class MplTrainer:
 
         def mb_body(carry, mb_i):
             params, partner_stack, vl_h, va_h, p_h = carry
-            vl, va = self.evaluate(params, val)
+            vl, va = self._maybe_val_eval(params, val, mb_i,
+                                          es_col=cfg.minibatch_count - 1)
             vl_h = vl_h.at[e, mb_i].set(vl)
             va_h = va_h.at[e, mb_i].set(va)
 
@@ -664,7 +695,10 @@ class MplTrainer:
         (params, opt_state, sums), _ = lax.scan(
             step, (state.params, state.opt_state, (0.0, 0.0, 0.0)),
             jnp.arange(steps))
-        vl, va = self.evaluate(params, val)
+        if cfg.record_val_history or cfg.is_early_stopping:
+            vl, va = self.evaluate(params, val)
+        else:
+            vl = va = jnp.full((), jnp.nan, jnp.float32)
         denom = jnp.maximum(sums[2], 1.0)
         vl_h = state.val_loss_h.at[e, 0].set(vl)
         va_h = state.val_acc_h.at[e, 0].set(va)
@@ -703,6 +737,12 @@ class MplTrainer:
             new = self._single_epoch(state, stacked, val, coal_mask, rng)
         else:
             new = self._seq_epoch(state, stacked, val, coal_mask, rng)
+
+        if cfg.approach == "lflip":
+            # end-of-epoch theta snapshot (reference overwrites
+            # history.theta[epoch][p] each minibatch, so the epoch's final
+            # value is what survives — multi_partner_learning.py:482-484)
+            new = new._replace(theta_h=new.theta_h.at[new.epoch].set(new.theta))
 
         # single-partner Keras-style ES bookkeeping
         if cfg.approach == "single":

@@ -48,11 +48,16 @@ def shard_map_norep(f, mesh, in_specs, out_specs):
                               out_specs=out_specs, check_rep=False)
 
 
-def train_state_specs(axis: str) -> TrainState:
+def train_state_specs(axis: str, lflip: bool = False) -> TrainState:
     """PartitionSpec pytree-prefix for a TrainState whose partner-indexed
-    leaves (theta, partner history) are sharded over `axis`."""
+    leaves (theta, partner history) are sharded over `axis`. theta/theta_h
+    only carry a partner dimension under lflip — other approaches hold
+    rank-1 `(0,)` placeholders, which must take rank-compatible specs."""
     r = P()
-    return TrainState(params=r, opt_state=r, theta=P(axis), epoch=r, done=r,
+    theta = P(axis) if lflip else P()
+    theta_h = P(None, axis) if lflip else P()
+    return TrainState(params=r, opt_state=r, theta=theta,
+                      theta_h=theta_h, epoch=r, done=r,
                       nb_epochs_done=r, best_val_loss=r, es_wait=r,
                       val_loss_h=r, val_acc_h=r, partner_h=P(None, axis))
 
@@ -83,7 +88,7 @@ class PartnerShardedTrainer:
         self.mesh = mesh
         self.axis = axis
         self.n_shards = mesh.shape[axis]
-        self._st = train_state_specs(axis)
+        self._st = train_state_specs(axis, lflip=cfg.approach == "lflip")
         self._sp = stacked_specs(axis)
         self._jits = {}
 

@@ -320,6 +320,11 @@ class Scenario:
                 logger.info(f"Resumed coalition cache from "
                             f"{self.contributivity_cache_from} "
                             f"({len(self._charac_engine.charac_fct_values)} entries)")
+            if not self.is_dry_run:
+                # incremental checkpointing: every trained device batch is
+                # durable immediately, so a crash mid-sweep resumes cheaply
+                self._charac_engine.autosave_path = \
+                    self.save_folder / "coalition_cache.json"
             contrib.compute_contributivity(method)
             self.append_contributivity(contrib)
             logger.info(f"## Evaluating contributivity with {method}: {contrib}")

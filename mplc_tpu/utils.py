@@ -36,46 +36,63 @@ def load_cfg(yaml_filepath):
     return cfg
 
 
+def _expand_dataset_dict(block):
+    """Yield one grid block per dataset when `dataset_name` uses the dict
+    sub-syntax `{mnist: [path, ...], cifar10: ~}`: each dataset becomes its
+    own block whose `init_model_from` axis is the mapped value (or
+    `random_initialization` for null) — reference utils.py:62-71 semantics.
+    """
+    names = block.get("dataset_name")
+    if not isinstance(names, dict):
+        yield block
+        return
+    for name, warm_starts in names.items():
+        sub = dict(block)
+        sub["dataset_name"] = [name]
+        sub["init_model_from"] = (["random_initialization"]
+                                  if warm_starts is None else warm_starts)
+        yield sub
+
+
+def _check_per_partner_lengths(scenario):
+    """Cross-field validation: every per-partner list must have exactly
+    `partners_count` entries (reference utils.py:80-87)."""
+    n = scenario["partners_count"]
+    amounts = scenario["amounts_per_partner"]
+    if len(amounts) != n:
+        raise Exception(
+            f"amounts_per_partner has {len(amounts)} entries but the "
+            f"scenario declares {n} partners.")
+    split = scenario.get("samples_split_option")
+    if split is not None and split[0] == "advanced" and len(split[1]) != n:
+        raise Exception(
+            f"advanced samples_split_option describes {len(split[1])} "
+            f"partners but the scenario declares {n}.")
+    if "corrupted_datasets" in scenario and \
+            len(scenario["corrupted_datasets"]) != n:
+        raise Exception(
+            f"corrupted_datasets has {len(scenario['corrupted_datasets'])} "
+            f"entries but the scenario declares {n} partners.")
+
+
 def get_scenario_params_list(config):
-    """Cartesian-product grid expansion (reference utils.py:41-91)."""
-    scenario_params_list = []
-    config_dataset = []
+    """Flatten the YAML `scenario_params_list` into one dict per scenario.
 
-    for list_scenario in config:
-        if isinstance(list_scenario["dataset_name"], dict):
-            for dataset_name in list_scenario["dataset_name"].keys():
-                dataset_scenario = list_scenario.copy()
-                dataset_scenario["dataset_name"] = [dataset_name]
-                if list_scenario["dataset_name"][dataset_name] is None:
-                    dataset_scenario["init_model_from"] = ["random_initialization"]
-                else:
-                    dataset_scenario["init_model_from"] = \
-                        list_scenario["dataset_name"][dataset_name]
-                config_dataset.append(dataset_scenario)
-        else:
-            config_dataset.append(list_scenario)
-
-    for list_scenario in config_dataset:
-        params_name = list_scenario.keys()
-        params_list = list(list_scenario.values())
-        for el in product(*params_list):
-            scenario = dict(zip(params_name, el))
-            if scenario["partners_count"] != len(scenario["amounts_per_partner"]):
-                raise Exception(
-                    "Length of amounts_per_partner does not match number of partners.")
-            if scenario.get("samples_split_option") is not None and \
-                    scenario["samples_split_option"][0] == "advanced" and \
-                    scenario["partners_count"] != len(scenario["samples_split_option"][1]):
-                raise Exception(
-                    "Length of samples_split_option does not match number of partners.")
-            if "corrupted_datasets" in params_name:
-                if scenario["partners_count"] != len(scenario["corrupted_datasets"]):
-                    raise Exception(
-                        "Length of corrupted_datasets does not match number of partners.")
-            scenario_params_list.append(scenario)
-
-    logger.info(f"Number of scenario(s) configured: {len(scenario_params_list)}")
-    return scenario_params_list
+    Every field in a block is a grid axis (its list of values is crossed
+    with all the others via itertools.product), and the `dataset_name` dict
+    sub-syntax fans out into per-dataset blocks first. Same expansion
+    semantics as reference utils.py:41-91.
+    """
+    scenarios = []
+    for block in config:
+        for sub in _expand_dataset_dict(block):
+            axes = list(sub.keys())
+            for combo in product(*sub.values()):
+                scenario = dict(zip(axes, combo))
+                _check_per_partner_lengths(scenario)
+                scenarios.append(scenario)
+    logger.info(f"Number of scenario(s) configured: {len(scenarios)}")
+    return scenarios
 
 
 def init_result_folder(yaml_filepath, cfg):

@@ -1,0 +1,224 @@
+"""Trained-SV parity oracle (BASELINE.md "SV parity"; SURVEY.md §4).
+
+An INDEPENDENT pure-NumPy re-implementation of the reference training loops:
+
+  - fedavg coalitions: broadcast -> per-partner local pass (fresh optimizer,
+    reference builds a new Keras model every fit_minibatch,
+    multi_partner_learning.py:310-332) -> data-volume weighted average
+    (mpl_utils.py:90-115), early stop on val_loss[e,0] vs val_loss[e-10,0]
+    (multi_partner_learning.py:177-193);
+  - single-partner coalitions: persistent optimizer + Keras-style
+    "no improvement for PATIENCE epochs" early stopping
+    (multi_partner_learning.py:230-275).
+
+v(S) = test accuracy of the final global model; exact Shapley values from
+the v table. The oracle shares ONLY the per-coalition initial weights with
+the production engine (fetched via the engine's deterministic coalition
+rng) — every gradient, optimizer update, aggregation and early-stopping
+decision is recomputed in NumPy. Agreement to 1e-3 on the full v(S) table
+and on the Shapley values validates the compiled coalition-masked/slotted
+trainer against the reference semantics end to end.
+
+The scenario uses minibatch_count=1 and gradient_updates_per_pass=1 so the
+training math is permutation-invariant (one full-batch step per partner per
+epoch) — RNG-dependent minibatch composition is covered by the
+batched==serial and slotted==masked equivalence tests instead.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+PATIENCE = 10  # constants.PATIENCE, reference mplc/constants.py:10
+ADAM_LR = 5e-2  # TITANIC_LOGREG optimizer (models/zoo.py)
+ADAM_B1, ADAM_B2, ADAM_EPS = 0.9, 0.999, 1e-7
+
+
+# ---------------------------------------------------------------------------
+# NumPy reference trainer
+# ---------------------------------------------------------------------------
+
+def _sigmoid(z):
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+def _bce_loss(z, y):
+    # same stable form as ops/metrics.py sigmoid_binary_cross_entropy
+    return float(np.mean(np.maximum(z, 0) - z * y + np.log1p(np.exp(-np.abs(z)))))
+
+
+def _logreg_grad(w, b, x, y):
+    z = x @ w + b
+    d = (_sigmoid(z) - y) / len(y)          # [n]
+    return x.T @ d, np.sum(d)
+
+
+def _adam_step(g, m, v, t, lr=ADAM_LR):
+    m = ADAM_B1 * m + (1 - ADAM_B1) * g
+    v = ADAM_B2 * v + (1 - ADAM_B2) * g * g
+    mh = m / (1 - ADAM_B1 ** t)
+    vh = v / (1 - ADAM_B2 ** t)
+    return -lr * mh / (np.sqrt(vh) + ADAM_EPS), m, v
+
+
+class NumpyFedAvgOracle:
+    """Reference fedavg loop on a logistic model, full-batch passes."""
+
+    def __init__(self, partners_xy, val_xy, test_xy, epochs):
+        self.partners_xy = partners_xy      # list of (x, y) per partner
+        self.val_xy = val_xy
+        self.test_xy = test_xy
+        self.epochs = epochs
+
+    def _val_loss(self, w, b):
+        xv, yv = self.val_xy
+        return _bce_loss(xv @ w + b, yv)
+
+    def train_coalition(self, subset, w0, b0):
+        """fedavg over the subset's partners; returns final (w, b)."""
+        datas = [self.partners_xy[i] for i in subset]
+        sizes = np.array([len(x) for x, _ in datas], float)
+        agg_w = sizes / sizes.sum()          # data-volume weights
+        w, b = w0.copy(), float(b0)
+        vl_h = []
+        for e in range(self.epochs):
+            # global val loss recorded at the START of the minibatch
+            # (multi_partner_learning.py:314)
+            vl_h.append(self._val_loss(w, b))
+            locals_ = []
+            for x, y in datas:
+                g_w, g_b = _logreg_grad(w, b, x, y)
+                # fresh optimizer per partner pass -> first adam step
+                up_w, _, _ = _adam_step(g_w, np.zeros_like(g_w),
+                                        np.zeros_like(g_w), 1)
+                up_b, _, _ = _adam_step(np.array([g_b]), np.zeros(1), np.zeros(1), 1)
+                locals_.append((w + up_w, b + float(up_b[0])))
+            w = sum(a * lw for a, (lw, _) in zip(agg_w, locals_))
+            b = float(sum(a * lb for a, (_, lb) in zip(agg_w, locals_)))
+            # reference early stop: val_loss[e,0] > val_loss[e-PATIENCE,0]
+            if e >= PATIENCE and vl_h[e] > vl_h[e - PATIENCE]:
+                break
+        return w, b
+
+    def train_single(self, i, w0, b0):
+        """persistent-optimizer single training + Keras-style ES."""
+        x, y = self.partners_xy[i]
+        w, b = w0.copy(), float(b0)
+        m_w = np.zeros_like(w)
+        v_w = np.zeros_like(w)
+        m_b = v_b = 0.0
+        best, wait = np.inf, 0
+        for t in range(1, self.epochs + 1):
+            g_w, g_b = _logreg_grad(w, b, x, y)
+            up_w, m_w, v_w = _adam_step(g_w, m_w, v_w, t)
+            m_b = ADAM_B1 * m_b + (1 - ADAM_B1) * g_b
+            v_b = ADAM_B2 * v_b + (1 - ADAM_B2) * g_b * g_b
+            b += float(-ADAM_LR * (m_b / (1 - ADAM_B1 ** t))
+                       / (np.sqrt(v_b / (1 - ADAM_B2 ** t)) + ADAM_EPS))
+            w = w + up_w
+            vl = self._val_loss(w, b)        # evaluated AFTER the epoch
+            if vl < best:
+                best, wait = vl, 0
+            else:
+                wait += 1
+                if wait >= PATIENCE:
+                    break
+        return w, b
+
+    def accuracy(self, w, b):
+        xt, yt = self.test_xy
+        return float(np.mean(((xt @ w + b) > 0) == (yt > 0.5)))
+
+
+# ---------------------------------------------------------------------------
+# fixture scenario: 3 partners, planted logistic data
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def parity_setup():
+    from mplc_tpu.data.datasets import Dataset
+    from mplc_tpu.models.zoo import TITANIC_LOGREG, TITANIC_NUM_FEATURES
+    from mplc_tpu.scenario import Scenario
+
+    rng = np.random.default_rng(123)
+    n_train, n_test = 900, 2000
+    w_true = rng.normal(0, 1.2, TITANIC_NUM_FEATURES)
+
+    def make(n):
+        x = rng.normal(0, 1, (n, TITANIC_NUM_FEATURES)).astype(np.float32)
+        y = (x @ w_true > 0).astype(np.float32)
+        flip = rng.uniform(size=n) < 0.08     # non-separable: scores differ
+        y[flip] = 1 - y[flip]
+        return x, y
+
+    x, y = make(n_train)
+    xt, yt = make(n_test)
+    ds = Dataset("titanic", (TITANIC_NUM_FEATURES,), 2, x, y, xt, yt,
+                 model=TITANIC_LOGREG, provenance="test")
+
+    sc = Scenario(partners_count=3, amounts_per_partner=[0.1, 0.3, 0.6],
+                  dataset=ds, multi_partner_learning_approach="fedavg",
+                  aggregation_weighting="data-volume",
+                  epoch_count=25, minibatch_count=1,
+                  gradient_updates_per_pass_count=1,
+                  experiment_path="/tmp/mplc_tpu_tests", seed=5)
+    sc.instantiate_scenario_partners()
+    sc.split_data(is_logging_enabled=False)
+    sc.compute_batch_sizes()
+    sc.data_corruption()
+    return sc
+
+
+def test_trained_sv_parity_vs_numpy_oracle(parity_setup):
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+    from mplc_tpu.contrib.shapley import (powerset_order,
+                                          shapley_from_characteristic)
+
+    sc = parity_setup
+    eng = CharacteristicEngine(sc)
+    subsets = powerset_order(3)
+    engine_vals = eng.evaluate(subsets)
+
+    partners_xy = [(np.asarray(p.x_train, np.float64),
+                    np.asarray(p.y_train, np.float64).reshape(-1))
+                   for p in sorted(sc.partners_list, key=lambda p: p.id)]
+    oracle = NumpyFedAvgOracle(
+        partners_xy,
+        (np.asarray(sc.dataset.x_val, np.float64),
+         np.asarray(sc.dataset.y_val, np.float64).reshape(-1)),
+        (np.asarray(sc.dataset.x_test, np.float64),
+         np.asarray(sc.dataset.y_test, np.float64).reshape(-1)),
+        epochs=sc.epoch_count)
+
+    oracle_table = {(): 0.0}
+    for s in subsets:
+        # identical initial weights: the engine's deterministic
+        # per-coalition rng; everything downstream is NumPy
+        params = jax.device_get(
+            sc.dataset.model.init(eng._coalition_rng(s)))
+        w0 = np.asarray(params["d1"]["w"], np.float64).reshape(-1)
+        b0 = float(np.asarray(params["d1"]["b"]).reshape(()))
+        if len(s) == 1:
+            w, b = oracle.train_single(s[0], w0, b0)
+        else:
+            w, b = oracle.train_coalition(s, w0, b0)
+        oracle_table[s] = oracle.accuracy(w, b)
+
+    oracle_vals = np.array([oracle_table[s] for s in subsets])
+    np.testing.assert_allclose(engine_vals, oracle_vals, atol=1e-3,
+                               err_msg="v(S) table diverges from the NumPy "
+                                       "reference implementation")
+
+    engine_table = {(): 0.0}
+    for s, v in zip(subsets, engine_vals):
+        engine_table[s] = float(v)
+    sv_engine = shapley_from_characteristic(3, engine_table)
+    sv_oracle = shapley_from_characteristic(3, oracle_table)
+    np.testing.assert_allclose(sv_engine, sv_oracle, atol=1e-3)
+
+    # the scores must actually discriminate (guards against the saturated
+    # all-equal degenerate case, BENCH_r02's flaw)
+    assert sv_oracle.max() - sv_oracle.min() > 2e-3
+    # and more data => more contribution on this planted task
+    assert sv_engine[2] > sv_engine[0]
