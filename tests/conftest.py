@@ -56,13 +56,5 @@ def tiny_image_dataset():
 @pytest.fixture(scope="session")
 def quick_scenario(tiny_image_dataset):
     """A 3-partner fedavg scenario, split and ready to train."""
-    from mplc_tpu.scenario import Scenario
-    sc = Scenario(partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
-                  dataset=tiny_image_dataset, epoch_count=4, minibatch_count=2,
-                  gradient_updates_per_pass_count=4, is_early_stopping=False,
-                  experiment_path="/tmp/mplc_tpu_tests", seed=3)
-    sc.instantiate_scenario_partners()
-    sc.split_data(is_logging_enabled=False)
-    sc.compute_batch_sizes()
-    sc.data_corruption()
-    return sc
+    from helpers import build_scenario
+    return build_scenario(dataset=tiny_image_dataset)

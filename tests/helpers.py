@@ -1,0 +1,24 @@
+"""Shared scenario builders for the test suite.
+
+One recipe for "construct a small 3-partner scenario and run the full prep
+sequence" (instantiate partners -> split -> batch sizes -> corruption), so
+the class-API, sharding, and fixture scenarios can't silently diverge.
+"""
+
+
+def build_scenario(**overrides):
+    """A prepped 3-partner scenario; pass `dataset=` or `dataset_name=`
+    plus any Scenario kwarg to override the quick defaults."""
+    from mplc_tpu.scenario import Scenario
+
+    params = dict(partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+                  epoch_count=4, minibatch_count=2,
+                  gradient_updates_per_pass_count=4, is_early_stopping=False,
+                  experiment_path="/tmp/mplc_tpu_tests", seed=3)
+    params.update(overrides)
+    sc = Scenario(**params)
+    sc.instantiate_scenario_partners()
+    sc.split_data(is_logging_enabled=False)
+    sc.compute_batch_sizes()
+    sc.data_corruption()
+    return sc

@@ -188,8 +188,17 @@ def test_registry_keys():
         "fedavg", "seq-pure", "seq-with-final-agg", "seqavg", "lflip"}
 
 
-def test_fedavg_class_runs(quick_scenario):
-    mpl = FederatedAverageLearning(quick_scenario)
+@pytest.fixture(scope="module")
+def logreg_class_scenario():
+    """A 3-partner titanic scenario for the class-API tests: the logreg
+    trainer compiles in seconds on CPU, where the CNN costs minutes — the
+    conv-backed class path is covered by the `slow`-marked variants."""
+    from helpers import build_scenario
+    return build_scenario(dataset_name="titanic")
+
+
+def test_fedavg_class_runs(logreg_class_scenario):
+    mpl = FederatedAverageLearning(logreg_class_scenario)
     score = mpl.fit()
     assert 0.0 <= score <= 1.0
     assert mpl.learning_computation_time > 0
@@ -200,6 +209,14 @@ def test_fedavg_class_runs(quick_scenario):
     assert set(["Partner", "Epoch", "Minibatch"]).issubset(df.columns)
 
 
+@pytest.mark.slow
+def test_fedavg_class_runs_cnn(quick_scenario):
+    mpl = FederatedAverageLearning(quick_scenario)
+    score = mpl.fit()
+    assert 0.0 <= score <= 1.0
+    assert mpl.history.history["mpl_model"]["val_loss"].shape == (4, 2)
+
+
 def test_fedavg_requires_multiple_partners(quick_scenario):
     import copy
     sc = copy.copy(quick_scenario)
@@ -208,7 +225,15 @@ def test_fedavg_requires_multiple_partners(quick_scenario):
         FederatedAverageLearning(sc)
 
 
-def test_single_partner_class(quick_scenario):
+def test_single_partner_class(logreg_class_scenario):
+    sc = logreg_class_scenario
+    mpl = SinglePartnerLearning(sc, partner=sc.partners_list[0])
+    score = mpl.fit()
+    assert 0.0 <= score <= 1.0
+
+
+@pytest.mark.slow
+def test_single_partner_class_cnn(quick_scenario):
     mpl = SinglePartnerLearning(quick_scenario,
                                 partner=quick_scenario.partners_list[0])
     score = mpl.fit()

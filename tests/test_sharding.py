@@ -38,16 +38,9 @@ def _logreg_scenario():
     """A 3-partner scenario on the titanic logistic model: the engine's
     sharded pipeline compiles in seconds (the CNN-backed sharded path is
     covered by the tiny-shape dryrun tests above)."""
-    from mplc_tpu.scenario import Scenario
-    sc = Scenario(partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
-                  dataset_name="titanic", epoch_count=2, minibatch_count=2,
-                  gradient_updates_per_pass_count=2, is_early_stopping=False,
-                  experiment_path="/tmp/mplc_tpu_tests", seed=9)
-    sc.instantiate_scenario_partners()
-    sc.split_data(is_logging_enabled=False)
-    sc.compute_batch_sizes()
-    sc.data_corruption()
-    return sc
+    from helpers import build_scenario
+    return build_scenario(dataset_name="titanic", epoch_count=2,
+                          gradient_updates_per_pass_count=2, seed=9)
 
 
 def test_engine_shards_over_devices():
