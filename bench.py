@@ -47,12 +47,134 @@ here: accuracy must not saturate, or every Shapley value degenerates to
 import json
 import os
 import sys
+import threading
 import time
 
-# Must be set before mplc_tpu.data.datasets builds the synthetic sets.
-os.environ.setdefault("MPLC_TPU_SYNTH_NOISE", "0.75")
-
 import numpy as np
+
+# ---------------------------------------------------------------------------
+# Watchdogs: the TPU here sits behind a network tunnel that can wedge (a
+# blocked await with an idle host, indistinguishable from a slow sweep
+# without a deadline). A hung bench is strictly worse than a failed one —
+# the driver records nothing either way, but a hang also eats the round.
+# ---------------------------------------------------------------------------
+
+_last_beat = time.monotonic()
+# Set the moment the stall watchdog declares the run dead: suppresses any
+# late _emit from a main thread that recovers mid-fallback (exactly one
+# metric line may reach stdout) and parks main at exit so the process
+# lives until the watchdog's os._exit.
+_watchdog_fired = threading.Event()
+
+
+def _beat():
+    global _last_beat
+    _last_beat = time.monotonic()
+
+
+def _start_stall_watchdog(platform: str):
+    """Abort when no device batch completes for BENCH_STALL_TIMEOUT
+    seconds. Default 30 min on accelerators — far above any per-batch
+    time, aimed at the wedge-able tunnel. On host-CPU runs there is no
+    tunnel to wedge and a single compile+train step of the conv models
+    can legitimately exceed any sane limit on this one-core box, so the
+    watchdog is OFF unless BENCH_STALL_TIMEOUT is set explicitly."""
+    default = "0" if platform == "cpu" else "1800"
+    limit = float(os.environ.get("BENCH_STALL_TIMEOUT", default))
+    if limit <= 0:
+        return
+
+    def watch():
+        while True:
+            time.sleep(15)
+            if time.monotonic() - _last_beat > limit:
+                print(f"[bench] FATAL: no progress for {limit:.0f} s — "
+                      "device tunnel presumed wedged, aborting",
+                      file=sys.stderr, flush=True)
+                _watchdog_fired.set()
+                # The main thread is blocked on the wedged device call and
+                # can't run the fallback; spawn it from here, then take the
+                # whole process down with the child's exit code. (sys.exit
+                # would only end this watchdog thread.) If the spawn itself
+                # blows up, still _exit — a dead watchdog thread would
+                # leave the wedged process hung forever.
+                try:
+                    if _fallback_allowed():
+                        os._exit(_spawn_cpu_fallback())
+                finally:
+                    os._exit(4)
+
+    threading.Thread(target=watch, daemon=True).start()
+
+
+def _devices_with_deadline():
+    """jax.devices() with a timeout, or None when backend init blocks:
+    init dials the tunnel and can hang forever when the remote grant is
+    stuck. BENCH_INIT_TIMEOUT seconds (default 240), 0 disables."""
+    import jax
+
+    limit = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
+    if limit <= 0:
+        return jax.devices()
+    result = {}
+
+    def init():
+        try:
+            result["devices"] = jax.devices()
+        except BaseException as e:  # surfaced in the main thread below
+            result["error"] = e
+
+    t = threading.Thread(target=init, daemon=True)
+    t.start()
+    t.join(limit)
+    if t.is_alive():
+        print(f"[bench] jax backend init did not finish in "
+              f"{limit:.0f} s — accelerator tunnel unresponsive",
+              file=sys.stderr, flush=True)
+        return None
+    if "error" in result:
+        raise result["error"]
+    return result["devices"]
+
+
+def _fallback_allowed() -> bool:
+    return (os.environ.get("BENCH_CPU_FALLBACK", "1") != "0"
+            and not os.environ.get("BENCH_IS_FALLBACK_CHILD"))
+
+
+def _spawn_cpu_fallback() -> int:
+    """The accelerator is unreachable. Rather than record nothing, re-exec
+    a REDUCED benchmark on the host CPU — titanic, 3 partners, 2 epochs —
+    with the metric explicitly suffixed `_cpu_fallback` so it can never be
+    mistaken for a TPU number. Returns the child's exit code."""
+    print("[bench] FALLBACK: re-running at reduced scale on the host CPU; "
+          "the emitted metric is suffixed _cpu_fallback and is NOT a TPU "
+          "measurement", file=sys.stderr, flush=True)
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    # Accelerator-tuned knobs from the parent must not leak into the CPU
+    # child, or fallback numbers vary with whatever TPU tuning was set —
+    # and a tight accelerator stall/init timeout would re-arm the child's
+    # watchdog, which is deliberately off on CPU.
+    for knob in ("BENCH_DTYPE", "MPLC_TPU_COALITIONS_PER_DEVICE",
+                 "MPLC_TPU_NO_SLOTS", "MPLC_TPU_SYNTH_SCALE",
+                 "BENCH_STALL_TIMEOUT", "BENCH_INIT_TIMEOUT"):
+        env.pop(knob, None)
+    env.update(
+        # A clean PYTHONPATH drops the ambient accelerator registration,
+        # so JAX_PLATFORMS=cpu is honored in the child. titanic: the only
+        # family whose trainers compile in seconds on this one-core host
+        # (the persistent CPU cache fails to reload AOT entries, so every
+        # process pays its compiles in full).
+        JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+        JAX_COMPILATION_CACHE_DIR=os.path.join(repo, ".jax_cache"),
+        BENCH_IS_FALLBACK_CHILD="1", BENCH_METRIC_SUFFIX="_cpu_fallback",
+        BENCH_CONFIG="1", BENCH_DATASET="titanic",
+        BENCH_PARTNERS="3", BENCH_EPOCHS="2")
+    return subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, cwd=repo).returncode
+
 
 REFERENCE_MNIST_FEDAVG_SECONDS = 589.0   # saved_experiments/.../results.csv mean
 REFERENCE_CIFAR_FEDAVG_SECONDS = 3030.0  # 〃 (cifar10 fedavg random rows)
@@ -89,6 +211,24 @@ def _make_scenario(dataset_name, n_partners, epochs, dtype, corrupted=None):
     return sc
 
 
+def _attach_progress(engine, label):
+    """Per-device-batch stderr progress: a silent hour means a wedged
+    tunnel, not a slow sweep — make the difference visible."""
+    t0 = time.perf_counter()
+    state = {"done": 0}
+
+    def cb(done_now, remaining, slot_count):
+        _beat()
+        state["done"] += done_now
+        print(f"[bench] {label}: +{done_now} coalitions "
+              f"(slots={slot_count}, total {state['done']}, "
+              f"{remaining} left in call) t={time.perf_counter() - t0:.0f}s",
+              file=sys.stderr, flush=True)
+
+    engine.progress = cb
+    return engine
+
+
 def _warm_engine(sc):
     """Compile every program the timed run will execute. The engine pads
     each evaluate() call to one bucket width per coalition size
@@ -102,15 +242,20 @@ def _warm_engine(sc):
 
     from mplc_tpu.contrib.engine import CharacteristicEngine
 
-    warm = CharacteristicEngine(sc)
+    warm = _attach_progress(CharacteristicEngine(sc), "warm")
     n = warm.partners_count
     n_dev = max(warm._sharding.num_devices if warm._sharding else 1, 1)
 
+    print(f"[bench] warm-up: singles ({min(n, n_dev * warm._device_batch_cap(None))} "
+          f"coalitions, compiling the single-partner pipeline)",
+          file=sys.stderr, flush=True)
     warm.evaluate([(i,) for i in
                    range(min(n, n_dev * warm._device_batch_cap(None)))])
     if warm._use_slots:
         for k in range(2, n + 1):
             w = min(comb(n, k), n_dev * warm._device_batch_cap(k))
+            print(f"[bench] warm-up: size={k} width={w} (compiling the "
+                  f"{k}-slot pipeline)", file=sys.stderr, flush=True)
             warm.evaluate(list(islice(combinations(range(n), k), w)))
     else:
         w = min(2 ** n - 1 - n, n_dev * warm._device_batch_cap(None))
@@ -133,6 +278,8 @@ def _fresh_engine(sc, warm):
 
 def _baseline_seconds(dataset_name, epochs, n_trainings):
     scale = float(os.environ.get("MPLC_TPU_SYNTH_SCALE", "1.0"))
+    if dataset_name == "titanic":
+        return 0.0  # no reference wall-clock exists (only an accuracy gate)
     per_training = (REFERENCE_CIFAR_FEDAVG_SECONDS
                     if dataset_name == "cifar10"
                     else REFERENCE_MNIST_FEDAVG_SECONDS)
@@ -140,27 +287,37 @@ def _baseline_seconds(dataset_name, epochs, n_trainings):
 
 
 def _emit(metric, elapsed, baseline):
+    if _watchdog_fired.is_set():
+        # The stall watchdog already took over (its fallback child owns
+        # stdout now); a recovered main thread must not add a second line.
+        return
     print(json.dumps({
-        "metric": metric,
+        "metric": metric + os.environ.get("BENCH_METRIC_SUFFIX", ""),
         "value": round(elapsed, 3),
         "unit": "s",
-        "vs_baseline": round(baseline / elapsed, 3),
+        # null, not 0.0, when no reference baseline exists (titanic):
+        # 0.0 would read as "infinitely slower", null reads as N/A.
+        "vs_baseline": round(baseline / elapsed, 3) if baseline else None,
     }))
 
 
 def bench_exact_shapley(epochs, dtype):
-    """Config 1 / north star: exact Shapley = all 2^N - 1 coalitions."""
+    """Config 1 / north star: exact Shapley = all 2^N - 1 coalitions.
+    BENCH_DATASET (default mnist) exists for the CPU-fallback path — the
+    titanic logreg compiles in seconds where the CNNs cost ~40 min of XLA
+    CPU compile on this one-core host."""
     from mplc_tpu.contrib.shapley import powerset_order, shapley_from_characteristic
 
+    dataset = os.environ.get("BENCH_DATASET", "mnist")
     n_partners = int(os.environ.get("BENCH_PARTNERS", "10"))
     coalitions = powerset_order(n_partners)
     B = len(coalitions)
 
-    sc = _make_scenario("mnist", n_partners, epochs, dtype)
+    sc = _make_scenario(dataset, n_partners, epochs, dtype)
     warm = _warm_engine(sc)
     print("[bench] compiled; timing...", file=sys.stderr)
 
-    timed = _fresh_engine(sc, warm)
+    timed = _attach_progress(_fresh_engine(sc, warm), "timed")
     t0 = time.perf_counter()
     accs = timed.evaluate(coalitions)
     elapsed = time.perf_counter() - t0
@@ -177,8 +334,8 @@ def bench_exact_shapley(epochs, dtype):
           f"{elapsed / B:.3f} s/coalition on {_ndev()} device(s); projected "
           f"v5e-8 (8-way coal sharding, zero-communication axis => ~linear): "
           f"{elapsed / 8:.1f} s", file=sys.stderr)
-    _emit(f"exact_shapley_mnist_{n_partners}partners_{epochs}epochs_wallclock",
-          elapsed, _baseline_seconds("mnist", epochs, B))
+    _emit(f"exact_shapley_{dataset}_{n_partners}partners_{epochs}epochs_wallclock",
+          elapsed, _baseline_seconds(dataset, epochs, B))
 
 
 def _bench_method(dataset_name, n_partners, method, epochs, dtype,
@@ -191,7 +348,7 @@ def _bench_method(dataset_name, n_partners, method, epochs, dtype,
     warm = _warm_engine(sc)
     print("[bench] compiled; timing...", file=sys.stderr)
 
-    timed = _fresh_engine(sc, warm)
+    timed = _attach_progress(_fresh_engine(sc, warm), "timed")
     t0 = time.perf_counter()
     contrib = Contributivity(sc)
     contrib.compute_contributivity(method)
@@ -217,15 +374,21 @@ def _ndev():
 
 
 def main():
-    import jax
-
+    # Must be set before mplc_tpu.data.datasets builds the synthetic sets
+    # (set here, not at module import, so merely importing bench for its
+    # helpers — as the tests do — leaves the process env untouched).
+    os.environ.setdefault("MPLC_TPU_SYNTH_NOISE", "0.75")
     config = os.environ.get("BENCH_CONFIG", "1")
     epochs = int(os.environ.get("BENCH_EPOCHS", "8"))
-    platform = jax.devices()[0].platform
+    devices = _devices_with_deadline()
+    if devices is None:
+        sys.exit(_spawn_cpu_fallback() if _fallback_allowed() else 3)
+    platform = devices[0].platform
+    _start_stall_watchdog(platform)
     default_dtype = "float32" if platform == "cpu" else "bfloat16"
     dtype = os.environ.get("BENCH_DTYPE", default_dtype)
-    print(f"[bench] config={config} devices={jax.devices()} dtype={dtype} "
-          f"epochs={epochs}", file=sys.stderr)
+    print(f"[bench] config={config} devices={devices} dtype={dtype} "
+          f"epochs={epochs}", file=sys.stderr, flush=True)
 
     if config == "1":
         bench_exact_shapley(epochs, dtype)
@@ -245,6 +408,12 @@ def main():
                       extra_methods=("Independent scores",))
     else:
         raise SystemExit(f"unknown BENCH_CONFIG={config!r} (use 1-5)")
+
+    if _watchdog_fired.is_set():
+        # The watchdog declared this run dead and its fallback child owns
+        # stdout/exit; returning would kill the daemon thread (and the
+        # child) mid-run. Park — the watchdog ends the process.
+        threading.Event().wait()
 
 
 if __name__ == "__main__":

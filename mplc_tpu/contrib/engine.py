@@ -141,6 +141,11 @@ class CharacteristicEngine:
         # a crash mid-sweep loses at most one batch of trained coalitions
         # (the reference loses everything — it checkpoints nothing).
         self.autosave_path = None
+        # Optional callable(done_in_group, remaining_in_call, slot_count)
+        # invoked after every completed device batch — long sweeps (and the
+        # bench) surface per-batch progress instead of going silent for the
+        # whole call.
+        self.progress = None
 
         self._sharding = coalition_sharding()
 
@@ -238,6 +243,8 @@ class CharacteristicEngine:
                 self._store(s, float(acc))
             if self.autosave_path is not None:
                 self.save_cache(self.autosave_path)
+            if self.progress is not None:
+                self.progress(len(group), len(subsets) - i, slot_count)
 
     def _store(self, subset: tuple, value: float) -> None:
         self.charac_fct_values[subset] = value

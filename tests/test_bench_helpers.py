@@ -1,0 +1,115 @@
+"""Unit coverage for bench.py's pure helpers — the bench is the driver's
+perf contract, so its accounting and watchdog plumbing get real tests."""
+
+import importlib
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+bench = importlib.import_module("bench")
+
+
+def test_amounts_are_normalized_and_uneven():
+    for n in (3, 4, 10):
+        a = bench._amounts(n)
+        assert len(a) == n
+        assert np.isclose(sum(a), 1.0)
+    assert bench._amounts(3) == [0.4, 0.3, 0.3]
+    a10 = bench._amounts(10)
+    # deliberately uneven so coalition values differ between partners
+    assert a10[0] < a10[-1]
+
+
+def test_baseline_seconds_accounting(monkeypatch):
+    monkeypatch.delenv("MPLC_TPU_SYNTH_SCALE", raising=False)
+    base = bench._baseline_seconds("mnist", 50, 1)
+    assert base == pytest.approx(bench.REFERENCE_MNIST_FEDAVG_SECONDS)
+    # linear in epochs and in the number of coalition trainings
+    assert bench._baseline_seconds("mnist", 25, 4) == pytest.approx(2 * base)
+    assert bench._baseline_seconds("cifar10", 50, 1) == pytest.approx(
+        bench.REFERENCE_CIFAR_FEDAVG_SECONDS)
+    monkeypatch.setenv("MPLC_TPU_SYNTH_SCALE", "0.5")
+    assert bench._baseline_seconds("mnist", 50, 1) == pytest.approx(base / 2)
+
+
+def test_progress_callback_reports_and_beats(capsys):
+    class FakeEngine:
+        progress = None
+
+    eng = bench._attach_progress(FakeEngine(), "timed")
+    bench._last_beat = 0.0  # sentinel: only a real _beat() can restore it
+    eng.progress(16, 100, 3)
+    eng.progress(16, 84, 3)
+    err = capsys.readouterr().err
+    assert "timed: +16 coalitions" in err
+    assert "total 32" in err
+    assert bench._last_beat > 0.0, "progress callback must feed the watchdog"
+
+
+def test_devices_deadline_returns_none_on_hang(monkeypatch):
+    """A backend init that never returns yields None, not a hang."""
+    monkeypatch.setenv("BENCH_INIT_TIMEOUT", "0.2")
+    import threading
+    hang = threading.Event()
+
+    class FakeJax:
+        @staticmethod
+        def devices():
+            hang.wait(5)
+            return []
+
+    monkeypatch.setitem(sys.modules, "jax", FakeJax())
+    assert bench._devices_with_deadline() is None
+    hang.set()
+
+
+def test_cpu_fallback_refuses_to_recurse(monkeypatch):
+    """The fallback child must never spawn another fallback."""
+    monkeypatch.setenv("BENCH_IS_FALLBACK_CHILD", "1")
+    assert not bench._fallback_allowed()
+    monkeypatch.delenv("BENCH_IS_FALLBACK_CHILD")
+    monkeypatch.setenv("BENCH_CPU_FALLBACK", "0")
+    assert not bench._fallback_allowed()
+    monkeypatch.setenv("BENCH_CPU_FALLBACK", "1")
+    assert bench._fallback_allowed()
+
+
+def test_metric_suffix_labels_fallback(monkeypatch, capsys):
+    import json
+    monkeypatch.setenv("BENCH_METRIC_SUFFIX", "_cpu_fallback")
+    bench._emit("m", 2.0, 4.0)
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["metric"] == "m_cpu_fallback"
+    assert rec["vs_baseline"] == 2.0
+
+
+def test_no_baseline_emits_null_not_zero(capsys):
+    import json
+    bench._emit("m", 2.0, 0.0)
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["vs_baseline"] is None
+    assert bench._baseline_seconds("titanic", 8, 100) == 0.0
+
+
+def test_emit_suppressed_once_watchdog_fires(capsys):
+    """After the watchdog takes over, a recovered main thread must not add
+    a second metric line to stdout."""
+    bench._watchdog_fired.set()
+    try:
+        bench._emit("m", 1.0, 1.0)
+        assert capsys.readouterr().out == ""
+    finally:
+        bench._watchdog_fired.clear()
+
+
+def test_importing_bench_leaves_env_alone(monkeypatch):
+    """Importing bench for its helpers (as this file does at collection
+    time) must not harden the synthetic datasets for the whole pytest
+    process — MPLC_TPU_SYNTH_NOISE is set inside main() only."""
+    import os
+    monkeypatch.delenv("MPLC_TPU_SYNTH_NOISE", raising=False)
+    importlib.reload(bench)
+    assert "MPLC_TPU_SYNTH_NOISE" not in os.environ
