@@ -58,3 +58,35 @@ def test_engine_shards_over_devices():
     vals2 = eng.evaluate(subsets)
     assert eng.first_charac_fct_calls_count == 7
     assert np.array_equal(vals, vals2)
+
+
+@pytest.mark.slow
+def test_full_ten_partner_sweep_sharded():
+    """North-star-shaped sweep at test scale: all 2^10 - 1 coalitions of a
+    10-partner titanic scenario, sharded over the 8-device mesh. Locks in
+    the per-size slot pipelines, fixed-width batching and memoization at
+    the BASELINE.md coalition count (the TPU bench differs only in model
+    family and hardware)."""
+    from helpers import build_scenario
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+    from mplc_tpu.contrib.shapley import (powerset_order,
+                                          shapley_from_characteristic)
+
+    amounts = [(i + 1) / 55 for i in range(10)]
+    sc = build_scenario(partners_count=10, amounts_per_partner=amounts,
+                        dataset_name="titanic", epoch_count=2,
+                        gradient_updates_per_pass_count=2, seed=4)
+    eng = CharacteristicEngine(sc)
+    subsets = powerset_order(10)
+    assert len(subsets) == 1023
+    vals = eng.evaluate(subsets)
+    assert vals.shape == (1023,)
+    assert np.isfinite(vals).all()
+    assert eng.first_charac_fct_calls_count == 1023
+    # the characteristic function must discriminate, not saturate
+    assert vals.max() - vals.min() > 0.01
+    sv = shapley_from_characteristic(10, eng.charac_fct_values)
+    assert np.isfinite(sv).all()
+    # efficiency: SVs sum to v(grand coalition)
+    grand = eng.charac_fct_values[tuple(range(10))]
+    assert np.isclose(sv.sum(), grand, atol=1e-5)
