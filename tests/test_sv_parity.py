@@ -135,8 +135,7 @@ class NumpyFedAvgOracle:
 # fixture scenario: 3 partners, planted logistic data
 # ---------------------------------------------------------------------------
 
-@pytest.fixture(scope="module")
-def parity_setup():
+def _make_parity_scenario(approach):
     from mplc_tpu.data.datasets import Dataset
     from mplc_tpu.models.zoo import TITANIC_LOGREG, TITANIC_NUM_FEATURES
     from mplc_tpu.scenario import Scenario
@@ -158,7 +157,7 @@ def parity_setup():
                  model=TITANIC_LOGREG, provenance="test")
 
     sc = Scenario(partners_count=3, amounts_per_partner=[0.1, 0.3, 0.6],
-                  dataset=ds, multi_partner_learning_approach="fedavg",
+                  dataset=ds, multi_partner_learning_approach=approach,
                   aggregation_weighting="data-volume",
                   epoch_count=25, minibatch_count=1,
                   gradient_updates_per_pass_count=1,
@@ -170,26 +169,33 @@ def parity_setup():
     return sc
 
 
-def test_trained_sv_parity_vs_numpy_oracle(parity_setup):
-    from mplc_tpu.contrib.engine import CharacteristicEngine
-    from mplc_tpu.contrib.shapley import (powerset_order,
-                                          shapley_from_characteristic)
+@pytest.fixture(scope="module")
+def parity_setup():
+    return _make_parity_scenario("fedavg")
 
-    sc = parity_setup
-    eng = CharacteristicEngine(sc)
-    subsets = powerset_order(3)
-    engine_vals = eng.evaluate(subsets)
 
+def _partners_val_test_arrays(sc):
     partners_xy = [(np.asarray(p.x_train, np.float64),
                     np.asarray(p.y_train, np.float64).reshape(-1))
                    for p in sorted(sc.partners_list, key=lambda p: p.id)]
-    oracle = NumpyFedAvgOracle(
-        partners_xy,
-        (np.asarray(sc.dataset.x_val, np.float64),
-         np.asarray(sc.dataset.y_val, np.float64).reshape(-1)),
-        (np.asarray(sc.dataset.x_test, np.float64),
-         np.asarray(sc.dataset.y_test, np.float64).reshape(-1)),
-        epochs=sc.epoch_count)
+    val = (np.asarray(sc.dataset.x_val, np.float64),
+           np.asarray(sc.dataset.y_val, np.float64).reshape(-1))
+    test = (np.asarray(sc.dataset.x_test, np.float64),
+            np.asarray(sc.dataset.y_test, np.float64).reshape(-1))
+    return partners_xy, val, test
+
+
+def _assert_engine_matches_oracle(sc, eng, oracle, err_tag):
+    """Run engine and oracle over the full 3-partner powerset from the same
+    per-coalition initial weights; assert v(S) and exact SVs agree to 1e-3
+    and that the scores discriminate (the saturated all-equal case —
+    BENCH_r02's flaw — must fail, not silently pass). Returns the engine
+    SVs for approach-specific assertions."""
+    from mplc_tpu.contrib.shapley import (powerset_order,
+                                          shapley_from_characteristic)
+
+    subsets = powerset_order(3)
+    engine_vals = eng.evaluate(subsets)
 
     oracle_table = {(): 0.0}
     for s in subsets:
@@ -207,18 +213,95 @@ def test_trained_sv_parity_vs_numpy_oracle(parity_setup):
 
     oracle_vals = np.array([oracle_table[s] for s in subsets])
     np.testing.assert_allclose(engine_vals, oracle_vals, atol=1e-3,
-                               err_msg="v(S) table diverges from the NumPy "
-                                       "reference implementation")
+                               err_msg=f"{err_tag} v(S) table diverges from "
+                                       "the NumPy reference implementation")
 
-    engine_table = {(): 0.0}
-    for s, v in zip(subsets, engine_vals):
-        engine_table[s] = float(v)
-    sv_engine = shapley_from_characteristic(3, engine_table)
+    sv_engine = shapley_from_characteristic(3, eng.charac_fct_values)
     sv_oracle = shapley_from_characteristic(3, oracle_table)
     np.testing.assert_allclose(sv_engine, sv_oracle, atol=1e-3)
-
-    # the scores must actually discriminate (guards against the saturated
-    # all-equal degenerate case, BENCH_r02's flaw)
     assert sv_oracle.max() - sv_oracle.min() > 2e-3
-    # and more data => more contribution on this planted task
+    return sv_engine
+
+
+def test_trained_sv_parity_vs_numpy_oracle(parity_setup):
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+
+    sc = parity_setup
+    eng = CharacteristicEngine(sc)
+    partners_xy, val, test = _partners_val_test_arrays(sc)
+    oracle = NumpyFedAvgOracle(partners_xy, val, test, epochs=sc.epoch_count)
+    sv_engine = _assert_engine_matches_oracle(sc, eng, oracle, "fedavg")
+    # more data => more contribution on this planted task
     assert sv_engine[2] > sv_engine[0]
+
+
+# ---------------------------------------------------------------------------
+# seq-pure parity: one shared model visits partners in a fresh random order
+# each round; the SAME model instance (and optimizer) is fit repeatedly
+# across the chain (reference multi_partner_learning.py:337-385 builds
+# `model_for_round` once per minibatch), no aggregation ever.
+# ---------------------------------------------------------------------------
+
+class NumpySeqOracle(NumpyFedAvgOracle):
+    """Reference seq-pure loop. Shares the visit-order randomness with the
+    engine (it is rng, like the initial weights — `order_fn(subset, e)`
+    returns the active partners in visit order); every gradient, the
+    threaded Adam state and the early stop are recomputed in NumPy."""
+
+    def __init__(self, partners_xy, val_xy, test_xy, epochs, order_fn):
+        super().__init__(partners_xy, val_xy, test_xy, epochs)
+        self.order_fn = order_fn
+
+    def train_coalition(self, subset, w0, b0):
+        w, b = w0.copy(), float(b0)
+        vl_h = []
+        for e in range(self.epochs):
+            # val recorded at the START of the round (pre-chain model)
+            vl_h.append(self._val_loss(w, b))
+            # one optimizer per round, threaded through the partner chain
+            m_w = np.zeros_like(w)
+            v_w = np.zeros_like(w)
+            m_b = np.zeros(1)
+            v_b = np.zeros(1)
+            t = 0
+            for i in self.order_fn(subset, e):
+                x, y = self.partners_xy[i]
+                g_w, g_b = _logreg_grad(w, b, x, y)
+                t += 1
+                up_w, m_w, v_w = _adam_step(g_w, m_w, v_w, t)
+                up_b, m_b, v_b = _adam_step(np.array([g_b]), m_b, v_b, t)
+                w = w + up_w
+                b += float(up_b[0])
+            if e >= PATIENCE and vl_h[e] > vl_h[e - PATIENCE]:
+                break
+        return w, b
+
+
+def test_trained_sv_parity_seq_pure():
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+
+    sc = _make_parity_scenario("seq-pure")
+    eng = CharacteristicEngine(sc)
+
+    def order_fn(subset, e):
+        """The engine's visit-order keys, re-derived: epoch rng =
+        fold_in(fold_in(K, i), e) with i the index inside the patience-
+        sized epoch chunk (contrib/engine.py scores: chunk = patience;
+        mpl/engine.py epoch_chunk/run_epoch), then
+        rng_mb = fold_in(fold_in(rng, 1), mb_i=0) and
+        keys = uniform(fold_in(rng_mb, 0), (P,)) with inactive partners
+        pushed to the back (+1e3)."""
+        K = eng._coalition_rng(tuple(subset))
+        i_in_chunk = e % PATIENCE
+        r = jax.random.fold_in(jax.random.fold_in(K, i_in_chunk), e)
+        rng_mb = jax.random.fold_in(jax.random.fold_in(r, 1), 0)
+        keys = np.asarray(jax.random.uniform(jax.random.fold_in(rng_mb, 0), (3,)))
+        mask = np.zeros(3)
+        mask[list(subset)] = 1.0
+        keys = keys + (1.0 - mask) * 1e3
+        return [int(p) for p in np.argsort(keys) if mask[p]]
+
+    partners_xy, val, test = _partners_val_test_arrays(sc)
+    oracle = NumpySeqOracle(partners_xy, val, test,
+                            epochs=sc.epoch_count, order_fn=order_fn)
+    _assert_engine_matches_oracle(sc, eng, oracle, "seq-pure")
