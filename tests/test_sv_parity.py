@@ -236,24 +236,30 @@ def test_trained_sv_parity_vs_numpy_oracle(parity_setup):
 
 
 # ---------------------------------------------------------------------------
-# seq-pure parity: one shared model visits partners in a fresh random order
-# each round; the SAME model instance (and optimizer) is fit repeatedly
-# across the chain (reference multi_partner_learning.py:337-385 builds
-# `model_for_round` once per minibatch), no aggregation ever.
+# sequential-family parity: one shared model visits partners in a fresh
+# random order each round; the SAME model instance (and optimizer) is fit
+# repeatedly across the chain (reference multi_partner_learning.py:337-385
+# builds `model_for_round` once per minibatch). seq-pure never aggregates;
+# seqavg ends every round with a data-volume weighted average of the
+# chain snapshots (:412-433).
 # ---------------------------------------------------------------------------
 
 class NumpySeqOracle(NumpyFedAvgOracle):
-    """Reference seq-pure loop. Shares the visit-order randomness with the
-    engine (it is rng, like the initial weights — `order_fn(subset, e)`
-    returns the active partners in visit order); every gradient, the
-    threaded Adam state and the early stop are recomputed in NumPy."""
+    """Reference seq-pure/seqavg loop. Shares the visit-order randomness
+    with the engine (it is rng, like the initial weights —
+    `order_fn(subset, e)` returns the active partners in visit order);
+    every gradient, the threaded Adam state, the seqavg aggregation and
+    the early stop are recomputed in NumPy."""
 
-    def __init__(self, partners_xy, val_xy, test_xy, epochs, order_fn):
+    def __init__(self, partners_xy, val_xy, test_xy, epochs, order_fn,
+                 aggregate=False):
         super().__init__(partners_xy, val_xy, test_xy, epochs)
         self.order_fn = order_fn
+        self.aggregate = aggregate   # seqavg: round ends in a weighted avg
 
     def train_coalition(self, subset, w0, b0):
         w, b = w0.copy(), float(b0)
+        sizes = {i: len(self.partners_xy[i][0]) for i in subset}
         vl_h = []
         for e in range(self.epochs):
             # val recorded at the START of the round (pre-chain model)
@@ -264,6 +270,7 @@ class NumpySeqOracle(NumpyFedAvgOracle):
             m_b = np.zeros(1)
             v_b = np.zeros(1)
             t = 0
+            snapshots = {}
             for i in self.order_fn(subset, e):
                 x, y = self.partners_xy[i]
                 g_w, g_b = _logreg_grad(w, b, x, y)
@@ -272,15 +279,23 @@ class NumpySeqOracle(NumpyFedAvgOracle):
                 up_b, m_b, v_b = _adam_step(np.array([g_b]), m_b, v_b, t)
                 w = w + up_w
                 b += float(up_b[0])
+                snapshots[i] = (w.copy(), b)
+            if self.aggregate:
+                # seqavg: data-volume weighted mean of the partners' chain
+                # snapshots (multi_partner_learning.py:412-433)
+                total = sum(sizes.values())
+                w = sum(sizes[i] / total * snapshots[i][0] for i in subset)
+                b = float(sum(sizes[i] / total * snapshots[i][1] for i in subset))
             if e >= PATIENCE and vl_h[e] > vl_h[e - PATIENCE]:
                 break
         return w, b
 
 
-def test_trained_sv_parity_seq_pure():
+@pytest.mark.parametrize("approach", ["seq-pure", "seqavg"])
+def test_trained_sv_parity_seq(approach):
     from mplc_tpu.contrib.engine import CharacteristicEngine
 
-    sc = _make_parity_scenario("seq-pure")
+    sc = _make_parity_scenario(approach)
     eng = CharacteristicEngine(sc)
 
     def order_fn(subset, e):
@@ -303,5 +318,6 @@ def test_trained_sv_parity_seq_pure():
 
     partners_xy, val, test = _partners_val_test_arrays(sc)
     oracle = NumpySeqOracle(partners_xy, val, test,
-                            epochs=sc.epoch_count, order_fn=order_fn)
-    _assert_engine_matches_oracle(sc, eng, oracle, "seq-pure")
+                            epochs=sc.epoch_count, order_fn=order_fn,
+                            aggregate=(approach == "seqavg"))
+    _assert_engine_matches_oracle(sc, eng, oracle, approach)
