@@ -1,9 +1,34 @@
-"""Shared scenario builders for the test suite.
+"""Shared scenario/model builders for the test suite.
 
 One recipe for "construct a small 3-partner scenario and run the full prep
 sequence" (instantiate partners -> split -> batch sizes -> corruption), so
-the class-API, sharding, and fixture scenarios can't silently diverge.
+the class-API, sharding, and fixture scenarios can't silently diverge —
+and one copy of the tiny categorical cluster MLP that the lflip/PVRL
+trajectory (test_e2e) and EM-oracle (test_lflip_em) tests both exercise.
 """
+
+
+def cluster_mlp_model(num_classes=4, in_features=16, hidden=32):
+    """2-layer categorical MLP that compiles in seconds on CPU."""
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+
+    from mplc_tpu.models import layers as L
+    from mplc_tpu.models.core import Model
+
+    def init(rng):
+        r1, r2 = jax.random.split(rng)
+        return {"d1": L.dense_init(r1, in_features, hidden),
+                "d2": L.dense_init(r2, hidden, num_classes)}
+
+    def apply(params, x, train=False, rng=None, compute_dtype=jnp.float32):
+        h = jax.nn.relu(L.dense(params["d1"], x.astype(compute_dtype)))
+        return L.dense(params["d2"], h).astype(jnp.float32)
+
+    return Model("cluster_mlp", init, apply, "categorical", num_classes,
+                 lambda: optax.adam(2e-2))
 
 
 def build_scenario(**overrides):

@@ -19,15 +19,9 @@ import sys
 from pathlib import Path
 
 import numpy as np
-import optax
 import pytest
 
-import jax
-import jax.numpy as jnp
-
 from mplc_tpu.data.datasets import Dataset, to_categorical
-from mplc_tpu.models import layers as L
-from mplc_tpu.models.core import Model
 from mplc_tpu.scenario import Scenario
 
 REPO = Path(__file__).resolve().parents[1]
@@ -71,16 +65,9 @@ def test_contributivity_ordering_oracle():
 
 def _cluster_mlp_dataset(n=600, num_classes=4, seed=20):
     """Tiny categorical problem: 4 Gaussian clusters, 2-layer MLP."""
-    def init(rng):
-        r1, r2 = jax.random.split(rng)
-        return {"d1": L.dense_init(r1, 16, 32), "d2": L.dense_init(r2, 32, num_classes)}
+    from helpers import cluster_mlp_model
 
-    def apply(params, x, train=False, rng=None, compute_dtype=jnp.float32):
-        h = jax.nn.relu(L.dense(params["d1"], x.astype(compute_dtype)))
-        return L.dense(params["d2"], h).astype(jnp.float32)
-
-    mlp = Model("cluster_mlp", init, apply, "categorical", num_classes,
-                lambda: optax.adam(2e-2))
+    mlp = cluster_mlp_model(num_classes)
     rng = np.random.default_rng(seed)
     centers = rng.normal(size=(num_classes, 16)).astype(np.float32) * 2.5
 

@@ -1,0 +1,122 @@
+"""Unit oracle for the lflip EM update (`MplTrainer._lflip_flip`).
+
+NumPy mirror of the reference scheme (multi_partner_learning.py:452-516):
+
+  theta_[i, :] = preds[i, :] * theta[:, argmax(y_i)]; l1-normalize COLUMNS
+  theta        = theta_.T @ y_batch;                  l1-normalize ROWS
+  theta_       = recompute with the new theta;        l1-normalize COLUMNS
+  y_flip[i]    ~ Categorical(theta_[i, :])  (first index with cdf >= u)
+
+The oracle shares only the model's predictions (and the uniform draw for
+the deterministic-flip check) with the engine — the EM algebra is
+recomputed in NumPy. The full lflip training trajectory is covered by
+tests/test_e2e.py::test_sbs_lflip_pvrl_methods; the discrete label
+resampling makes a trajectory-level parity oracle flaky by construction,
+so the EM step is pinned down here instead.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+K = 4      # classes
+N = 12     # minibatch rows
+
+
+@pytest.fixture(scope="module")
+def lflip_parts():
+    from helpers import cluster_mlp_model
+    from mplc_tpu.mpl.engine import MplTrainer, TrainConfig
+
+    model = cluster_mlp_model(K)
+    cfg = TrainConfig(approach="lflip", aggregator="data-volume",
+                      epoch_count=1, minibatch_count=1,
+                      gradient_updates_per_pass=1, is_early_stopping=False)
+    trainer = MplTrainer(model, cfg)
+    params = model.init(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(77)
+    x = rng.normal(size=(N, 16)).astype(np.float32)
+    y = np.eye(K, dtype=np.float32)[rng.integers(0, K, N)]
+    preds = np.asarray(jax.nn.softmax(model.apply(params, x), axis=-1),
+                       np.float64)
+    return trainer, model, params, x, y, preds
+
+
+def _l1_cols(a):
+    return a / np.maximum(np.sum(np.abs(a), axis=0, keepdims=True), 1e-12)
+
+
+def _l1_rows(a):
+    return a / np.maximum(np.sum(np.abs(a), axis=1, keepdims=True), 1e-12)
+
+
+def _reference_em(preds, y, theta):
+    """The reference's EM algebra, straight from the loop at
+    multi_partner_learning.py:478-489 (row i scaled by theta[:, argmax y_i]
+    == preds * (y @ theta.T) for one-hot y)."""
+    theta_post = _l1_cols(preds * (y @ theta.T))
+    new_theta = _l1_rows(theta_post.T @ y)
+    theta_post2 = _l1_cols(preds * (y @ new_theta.T))
+    return new_theta, theta_post2
+
+
+def _run_flip(trainer, params, x, y, theta, rng):
+    perm = jnp.arange(N, dtype=jnp.int32)
+    new_theta, y_flip, idx, valid = trainer._lflip_flip(
+        params, jnp.asarray(theta, jnp.float32), jnp.asarray(x),
+        jnp.asarray(y), perm, jnp.asarray(N, jnp.int32), 0, N, rng)
+    assert np.asarray(valid).all()
+    return np.asarray(new_theta, np.float64), np.asarray(y_flip)
+
+
+def test_lflip_theta_update_matches_reference_em(lflip_parts):
+    trainer, model, params, x, y, preds = lflip_parts
+    rng0 = np.random.default_rng(3)
+    # a generic (non-uniform, non-identity) flip matrix, rows on the simplex
+    theta = _l1_rows(rng0.uniform(0.1, 1.0, (K, K)))
+
+    new_theta, y_flip = _run_flip(trainer, params, x, y, theta,
+                                  jax.random.PRNGKey(9))
+    oracle_theta, oracle_post = _reference_em(preds, y, theta)
+
+    np.testing.assert_allclose(new_theta, oracle_theta, atol=1e-5)
+    # rows of the updated flip matrix are distributions
+    np.testing.assert_allclose(new_theta.sum(axis=1), np.ones(K), atol=1e-5)
+    # resampled labels are one-hot over K classes
+    assert y_flip.shape == (N, K)
+    np.testing.assert_allclose(y_flip.sum(axis=1), np.ones(N), atol=0)
+
+
+def test_lflip_identity_theta_keeps_confident_labels(lflip_parts):
+    """With theta = I the posterior is proportional to preds * y — each
+    row's distribution is a point mass on the observed label, so the draw
+    must reproduce y exactly (no flipping), for any rng."""
+    trainer, model, params, x, y, preds = lflip_parts
+    theta = np.eye(K)
+
+    _, y_flip = _run_flip(trainer, params, x, y, theta,
+                          jax.random.PRNGKey(123))
+    np.testing.assert_array_equal(y_flip, y)
+
+
+def test_lflip_draw_follows_posterior(lflip_parts):
+    """The categorical draw must follow the post-update posterior: with
+    the engine's own uniform u (shared rng, like the parity oracles) the
+    drawn class is the first index where the row cdf reaches u."""
+    trainer, model, params, x, y, preds = lflip_parts
+    rng0 = np.random.default_rng(5)
+    theta = _l1_rows(rng0.uniform(0.1, 1.0, (K, K)))
+    key = jax.random.PRNGKey(42)
+
+    _, y_flip = _run_flip(trainer, params, x, y, theta, key)
+    _, oracle_post = _reference_em(preds, y, theta)
+
+    u = np.asarray(jax.random.uniform(key, (N, 1)), np.float64)
+    cdf = np.cumsum(oracle_post, axis=1)
+    u = u * np.maximum(cdf[:, -1:], 1e-12)
+    expect = np.argmax(u <= cdf, axis=1)
+    np.testing.assert_array_equal(np.argmax(y_flip, axis=1), expect)
