@@ -19,10 +19,13 @@ decision is recomputed in NumPy. Agreement to 1e-3 on the full v(S) table
 and on the Shapley values validates the compiled coalition-masked/slotted
 trainer against the reference semantics end to end.
 
-The scenario uses minibatch_count=1 and gradient_updates_per_pass=1 so the
-training math is permutation-invariant (one full-batch step per partner per
-epoch) — RNG-dependent minibatch composition is covered by the
-batched==serial and slotted==masked equivalence tests instead.
+The fedavg / seq-pure / seqavg scenarios use minibatch_count=1 and
+gradient_updates_per_pass=1 so the training math is permutation-invariant
+(one full-batch step per partner per epoch). The seq-with-final-agg test
+runs at minibatch_count=2 (at MB=1 it coincides with seqavg) and re-derives
+the engine's minibatch windows from the shared rng streams, so RNG-dependent
+minibatch composition is oracle-checked here too — complementing the
+batched==serial and slotted==masked equivalence tests.
 """
 
 import numpy as np
@@ -135,7 +138,7 @@ class NumpyFedAvgOracle:
 # fixture scenario: 3 partners, planted logistic data
 # ---------------------------------------------------------------------------
 
-def _make_parity_scenario(approach):
+def _make_parity_scenario(approach, minibatch_count=1):
     from mplc_tpu.data.datasets import Dataset
     from mplc_tpu.models.zoo import TITANIC_LOGREG, TITANIC_NUM_FEATURES
     from mplc_tpu.scenario import Scenario
@@ -159,7 +162,7 @@ def _make_parity_scenario(approach):
     sc = Scenario(partners_count=3, amounts_per_partner=[0.1, 0.3, 0.6],
                   dataset=ds, multi_partner_learning_approach=approach,
                   aggregation_weighting="data-volume",
-                  epoch_count=25, minibatch_count=1,
+                  epoch_count=25, minibatch_count=minibatch_count,
                   gradient_updates_per_pass_count=1,
                   experiment_path="/tmp/mplc_tpu_tests", seed=5)
     sc.instantiate_scenario_partners()
@@ -291,6 +294,29 @@ class NumpySeqOracle(NumpyFedAvgOracle):
         return w, b
 
 
+def _engine_epoch_rng(eng, subset, e):
+    """The engine's per-epoch rng, re-derived: fold_in(fold_in(K, i), e)
+    with i the index inside the patience-sized epoch chunk
+    (contrib/engine.py scores: chunk = patience; mpl/engine.py
+    epoch_chunk/run_epoch)."""
+    K = eng._coalition_rng(tuple(subset))
+    return jax.random.fold_in(jax.random.fold_in(K, e % PATIENCE), e)
+
+
+def _seq_visit_order(eng, subset, e, mb_i):
+    """The engine's visit-order keys, re-derived:
+    rng_mb = fold_in(fold_in(rng_e, 1), mb_i) and
+    keys = uniform(fold_in(rng_mb, 0), (P,)) with inactive partners
+    pushed to the back (+1e3) (mpl/engine.py _seq_epoch)."""
+    r = _engine_epoch_rng(eng, subset, e)
+    rng_mb = jax.random.fold_in(jax.random.fold_in(r, 1), mb_i)
+    keys = np.asarray(jax.random.uniform(jax.random.fold_in(rng_mb, 0), (3,)))
+    mask = np.zeros(3)
+    mask[list(subset)] = 1.0
+    keys = keys + (1.0 - mask) * 1e3
+    return [int(p) for p in np.argsort(keys) if mask[p]]
+
+
 @pytest.mark.parametrize("approach", ["seq-pure", "seqavg"])
 def test_trained_sv_parity_seq(approach):
     from mplc_tpu.contrib.engine import CharacteristicEngine
@@ -299,25 +325,152 @@ def test_trained_sv_parity_seq(approach):
     eng = CharacteristicEngine(sc)
 
     def order_fn(subset, e):
-        """The engine's visit-order keys, re-derived: epoch rng =
-        fold_in(fold_in(K, i), e) with i the index inside the patience-
-        sized epoch chunk (contrib/engine.py scores: chunk = patience;
-        mpl/engine.py epoch_chunk/run_epoch), then
-        rng_mb = fold_in(fold_in(rng, 1), mb_i=0) and
-        keys = uniform(fold_in(rng_mb, 0), (P,)) with inactive partners
-        pushed to the back (+1e3)."""
-        K = eng._coalition_rng(tuple(subset))
-        i_in_chunk = e % PATIENCE
-        r = jax.random.fold_in(jax.random.fold_in(K, i_in_chunk), e)
-        rng_mb = jax.random.fold_in(jax.random.fold_in(r, 1), 0)
-        keys = np.asarray(jax.random.uniform(jax.random.fold_in(rng_mb, 0), (3,)))
-        mask = np.zeros(3)
-        mask[list(subset)] = 1.0
-        keys = keys + (1.0 - mask) * 1e3
-        return [int(p) for p in np.argsort(keys) if mask[p]]
+        return _seq_visit_order(eng, subset, e, 0)
 
     partners_xy, val, test = _partners_val_test_arrays(sc)
     oracle = NumpySeqOracle(partners_xy, val, test,
                             epochs=sc.epoch_count, order_fn=order_fn,
                             aggregate=(approach == "seqavg"))
     _assert_engine_matches_oracle(sc, eng, oracle, approach)
+
+
+# ---------------------------------------------------------------------------
+# seq-with-final-agg parity. At minibatch_count=1 this approach coincides
+# numerically with seqavg (both aggregate each partner's last chain snapshot
+# once per epoch), so the test runs at minibatch_count=2 — per-epoch
+# aggregation is then genuinely distinct from seqavg's per-minibatch one
+# (reference multi_partner_learning.py:388-409 vs :412-433) — and the oracle
+# re-derives the engine's minibatch windows from the shared rng streams the
+# same way the seq test re-derives visit order.
+# ---------------------------------------------------------------------------
+
+class NumpySeqFinalAggOracle(NumpyFedAvgOracle):
+    """Reference seq-with-final-agg loop: sequential partner chain per
+    minibatch (fresh optimizer per minibatch, threaded along the chain), ONE
+    data-volume weighted aggregation of each partner's last chain snapshot
+    at the END of every epoch. Early stopping reads the global val loss
+    recorded at the start of minibatch MB-1 (the seq-family column quirk,
+    multi_partner_learning.py:299 vs seq variants)."""
+
+    def __init__(self, partners_xy, val_xy, test_xy, epochs, mb_count,
+                 order_fn, window_fn, single_perm_fn):
+        super().__init__(partners_xy, val_xy, test_xy, epochs)
+        self.mb_count = mb_count
+        self.order_fn = order_fn            # (subset, e, mb_i) -> visit order
+        self.window_fn = window_fn          # (subset, e, i, mb_i) -> row idx
+        self.single_perm_fn = single_perm_fn  # (subset, e) -> epoch perm rows
+
+    def train_coalition(self, subset, w0, b0):
+        w, b = w0.copy(), float(b0)
+        sizes = {i: len(self.partners_xy[i][0]) for i in subset}
+        total = float(sum(sizes.values()))
+        vl_h = []
+        for e in range(self.epochs):
+            snapshots = {}
+            vl = np.inf
+            for mb_i in range(self.mb_count):
+                vl = self._val_loss(w, b)   # start-of-minibatch global val
+                m_w = np.zeros_like(w)
+                v_w = np.zeros_like(w)
+                m_b = np.zeros(1)
+                v_b = np.zeros(1)
+                t = 0
+                for i in self.order_fn(subset, e, mb_i):
+                    x, y = self.partners_xy[i]
+                    rows = self.window_fn(subset, e, i, mb_i)
+                    g_w, g_b = _logreg_grad(w, b, x[rows], y[rows])
+                    t += 1
+                    up_w, m_w, v_w = _adam_step(g_w, m_w, v_w, t)
+                    up_b, m_b, v_b = _adam_step(np.array([g_b]), m_b, v_b, t)
+                    w = w + up_w
+                    b += float(up_b[0])
+                    snapshots[i] = (w.copy(), b)
+            vl_h.append(vl)                 # ES column = minibatch MB-1
+            # the per-EPOCH aggregation that defines this approach
+            w = sum(sizes[i] / total * snapshots[i][0] for i in subset)
+            b = float(sum(sizes[i] / total * snapshots[i][1] for i in subset))
+            if e >= PATIENCE and vl_h[e] > vl_h[e - PATIENCE]:
+                break
+        return w, b
+
+    def train_single(self, i, w0, b0):
+        """Single-partner training at minibatch_count=2: TWO persistent-
+        optimizer steps per epoch over halves of the epoch's shuffled perm
+        (mpl/engine.py _single_epoch: steps = mb_count * gup)."""
+        x, y = self.partners_xy[i]
+        n = len(x)
+        steps = self.mb_count               # gradient_updates_per_pass = 1
+        sb = -(-n // steps)                 # ceil: samples per step
+        w, b = w0.copy(), float(b0)
+        m_w = np.zeros_like(w)
+        v_w = np.zeros_like(w)
+        m_b = v_b = 0.0
+        best, wait = np.inf, 0
+        t = 0
+        for e in range(self.epochs):
+            perm = self.single_perm_fn((i,), e)
+            for g in range(steps):
+                rows = perm[g * sb:min((g + 1) * sb, n)]
+                g_w, g_b = _logreg_grad(w, b, x[rows], y[rows])
+                t += 1
+                up_w, m_w, v_w = _adam_step(g_w, m_w, v_w, t)
+                m_b = ADAM_B1 * m_b + (1 - ADAM_B1) * g_b
+                v_b = ADAM_B2 * v_b + (1 - ADAM_B2) * g_b * g_b
+                b += float(-ADAM_LR * (m_b / (1 - ADAM_B1 ** t))
+                           / (np.sqrt(v_b / (1 - ADAM_B2 ** t)) + ADAM_EPS))
+                w = w + up_w
+            vl = self._val_loss(w, b)       # evaluated AFTER the epoch
+            if vl < best:
+                best, wait = vl, 0
+            else:
+                wait += 1
+                if wait >= PATIENCE:
+                    break
+        return w, b
+
+
+def test_trained_sv_parity_seq_with_final_agg():
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+
+    MB = 2
+    sc = _make_parity_scenario("seq-with-final-agg", minibatch_count=MB)
+    eng = CharacteristicEngine(sc)
+    n_max = eng.stacked.n_max
+    mask_np = np.asarray(eng.stacked.mask)
+    sizes_np = np.asarray(eng.stacked.sizes)
+
+    def partner_perm(subset, e, i):
+        # _epoch_perms: per-partner key = fold_in(fold_in(rng_e, 0), i);
+        # padding rows pushed to the back (+1e9)
+        import jax.numpy as jnp
+        r0 = jax.random.fold_in(_engine_epoch_rng(eng, subset, e), 0)
+        keys = jax.random.uniform(jax.random.fold_in(r0, i), (n_max,)) \
+            + (1.0 - jnp.asarray(mask_np[i])) * 1e9
+        # jnp.argsort (stable) exactly as the engine: np's default quicksort
+        # could order tied float32 keys differently across a window boundary
+        return np.asarray(jnp.argsort(keys))
+
+    def order_fn(subset, e, mb_i):
+        return _seq_visit_order(eng, subset, e, mb_i)
+
+    def window_fn(subset, e, i, mb_i):
+        valid_mb = int(sizes_np[i]) // MB   # remainder rows dropped
+        perm = partner_perm(subset, e, i)
+        return perm[mb_i * valid_mb:(mb_i + 1) * valid_mb]
+
+    def single_perm_fn(subset, e):
+        # _single_epoch: keys = uniform(fold_in(rng_e, 0), (n_max,)) — no
+        # per-partner fold (the lone partner is selected by the mask)
+        import jax.numpy as jnp
+        (i,) = subset
+        r0 = jax.random.fold_in(_engine_epoch_rng(eng, subset, e), 0)
+        keys = jax.random.uniform(r0, (n_max,)) \
+            + (1.0 - jnp.asarray(mask_np[i])) * 1e9
+        return np.asarray(jnp.argsort(keys))[:int(sizes_np[i])]
+
+    partners_xy, val, test = _partners_val_test_arrays(sc)
+    oracle = NumpySeqFinalAggOracle(partners_xy, val, test,
+                                    epochs=sc.epoch_count, mb_count=MB,
+                                    order_fn=order_fn, window_fn=window_fn,
+                                    single_perm_fn=single_perm_fn)
+    _assert_engine_matches_oracle(sc, eng, oracle, "seq-with-final-agg")
