@@ -74,12 +74,16 @@ def _beat():
 
 def _start_stall_watchdog(platform: str):
     """Abort when no device batch completes for BENCH_STALL_TIMEOUT
-    seconds. Default 30 min on accelerators — far above any per-batch
-    time, aimed at the wedge-able tunnel. On host-CPU runs there is no
-    tunnel to wedge and a single compile+train step of the conv models
-    can legitimately exceed any sane limit on this one-core box, so the
-    watchdog is OFF unless BENCH_STALL_TIMEOUT is set explicitly."""
-    default = "0" if platform == "cpu" else "1800"
+    seconds. Default 15 min on accelerators — measured batches take
+    <= ~70 s (size-10 slot pipeline) and a residual compile <= ~3 min,
+    so 900 s is ~4x any legitimate gap while wasting half as much of a
+    wedged round as the previous 30 min default (the tunnel wedged twice
+    on 2026-07-30; both times it stayed dead long past any timeout). On
+    host-CPU runs there is no tunnel to wedge and a single compile+train
+    step of the conv models can legitimately exceed any sane limit on
+    this one-core box, so the watchdog is OFF unless BENCH_STALL_TIMEOUT
+    is set explicitly."""
+    default = "0" if platform == "cpu" else "900"
     limit = float(os.environ.get("BENCH_STALL_TIMEOUT", default))
     if limit <= 0:
         return
@@ -286,6 +290,64 @@ def _baseline_seconds(dataset_name, epochs, n_trainings):
     return per_training * (epochs / REFERENCE_EPOCH_BUDGET) * scale * n_trainings
 
 
+def _fwd_flops_per_sample(engine):
+    """Forward-pass FLOPs per sample from XLA's cost model (the trained
+    model's inference program on one eval chunk, compiled once — cached by
+    the persistent compilation cache); None when the backend doesn't
+    expose cost analysis."""
+    try:
+        import jax
+        model = engine.model
+        dtype = engine.multi_pipe.trainer.cfg.dtype
+        x = engine.val.x[0]
+        f = jax.jit(lambda p, xx: model.apply(p, xx, train=False,
+                                              compute_dtype=dtype))
+        params = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+        c = f.lower(params, jax.ShapeDtypeStruct(x.shape, x.dtype)).compile()
+        ca = c.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca["flops"]) / x.shape[0]
+    except Exception as e:
+        print(f"[bench] FLOPs estimate unavailable: {e}", file=sys.stderr)
+        return None
+
+
+def _peak_flops_per_chip():
+    """bf16 peak of the attached chip (public spec sheets); None = unknown."""
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    table = {"tpu v5 lite": 197e12, "tpu v5e": 197e12, "tpu v5p": 459e12,
+             "tpu v4": 275e12, "tpu v6 lite": 918e12, "tpu v6e": 918e12}
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return None
+
+
+def _throughput_note(engine, elapsed):
+    """Training throughput of the timed sweep: coalition-epochs/s, training
+    samples/s, and a conservative model-FLOPs rate (fwd+bwd ~ 3x fwd; val /
+    test evals and padded batch slots excluded — the true device rate is
+    higher). The MFU estimate divides by the chip's bf16 peak."""
+    ep, sa = engine.epochs_trained, engine.samples_trained
+    if not ep or elapsed <= 0:
+        return
+    line = (f"[bench] throughput: {ep} coalition-epochs "
+            f"({ep / elapsed:.2f}/s), "
+            f"{sa / elapsed / 1e3:.1f}k training samples/s")
+    flops = _fwd_flops_per_sample(engine)
+    if flops:
+        achieved = 3.0 * flops * sa / elapsed
+        line += f", >={achieved / 1e12:.2f} TFLOP/s model compute"
+        peak = _peak_flops_per_chip()
+        if peak:
+            # samples_trained aggregates across all devices — normalize by
+            # the whole attached fleet's peak, not one chip's
+            line += f" (>={100 * achieved / (peak * _ndev()):.1f}% MFU)"
+    print(line, file=sys.stderr, flush=True)
+
+
 def _emit(metric, elapsed, baseline):
     if _watchdog_fired.is_set():
         # The stall watchdog already took over (its fallback child owns
@@ -334,6 +396,7 @@ def bench_exact_shapley(epochs, dtype):
           f"{elapsed / B:.3f} s/coalition on {_ndev()} device(s); projected "
           f"v5e-8 (8-way coal sharding, zero-communication axis => ~linear): "
           f"{elapsed / 8:.1f} s", file=sys.stderr)
+    _throughput_note(timed, elapsed)
     _emit(f"exact_shapley_{dataset}_{n_partners}partners_{epochs}epochs_wallclock",
           elapsed, _baseline_seconds(dataset, epochs, B))
 
@@ -363,6 +426,7 @@ def _bench_method(dataset_name, n_partners, method, epochs, dtype,
     print(f"[bench] {elapsed:.1f} s for {calls} distinct coalition trainings "
           f"({elapsed / max(calls, 1):.3f} s each) on {_ndev()} device(s)",
           file=sys.stderr)
+    _throughput_note(timed, elapsed)
     tag = method.lower().replace(" ", "_")
     _emit(f"{tag}_{dataset_name}_{n_partners}partners_{epochs}epochs_wallclock",
           elapsed, _baseline_seconds(dataset_name, epochs, calls))
@@ -385,6 +449,17 @@ def main():
         sys.exit(_spawn_cpu_fallback() if _fallback_allowed() else 3)
     platform = devices[0].platform
     _start_stall_watchdog(platform)
+    try:
+        # Persistent compilation cache: a bench run's ~15 min of slot-
+        # pipeline compiles is paid once per (program, topology) — later
+        # runs on the same chip (e.g. the driver's end-of-round run after a
+        # manual one) reload executables from disk.
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                       ".jax_cache"))
+    except Exception as e:
+        print(f"[bench] compile cache disabled: {e}", file=sys.stderr)
     default_dtype = "float32" if platform == "cpu" else "bfloat16"
     dtype = os.environ.get("BENCH_DTYPE", default_dtype)
     print(f"[bench] config={config} devices={devices} dtype={dtype} "

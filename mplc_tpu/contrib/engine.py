@@ -61,7 +61,9 @@ class BatchedTrainerPipeline:
         self._fin = trainer.jit_batched_finalize
 
     def scores(self, masks: jnp.ndarray, rngs: jnp.ndarray, stacked, val, test,
-               base_rng) -> np.ndarray:
+               base_rng) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (test_accuracies, epochs_trained) per coalition in the
+        batch — epochs_trained feeds the engine's throughput accounting."""
         cfg = self.trainer.cfg
         state = self._init(rngs, self.partners_count)
         chunk = cfg.patience if cfg.is_early_stopping else cfg.epoch_count
@@ -74,7 +76,8 @@ class BatchedTrainerPipeline:
             if bool(jax.device_get(jnp.all(state.done))):
                 break
         _, accs = self._fin(state, test)
-        return np.asarray(jax.device_get(accs))
+        return (np.asarray(jax.device_get(accs)),
+                np.asarray(jax.device_get(state.nb_epochs_done)))
 
 
 class CharacteristicEngine:
@@ -112,7 +115,15 @@ class CharacteristicEngine:
             epoch_count=scenario.epoch_count,
             minibatch_count=scenario.minibatch_count,
             gradient_updates_per_pass=scenario.gradient_updates_per_pass_count,
-            is_early_stopping=True,
+            # The reference always trains coalitions with early stopping on
+            # (contributivity.py:102-106), but with epoch_count <= patience
+            # the stop condition can never fire (both the [e]-vs-[e-patience]
+            # rule and the single trainer's wait counter need > patience
+            # epochs) — so the flag's only effect would be one wasted val
+            # eval per epoch per coalition. Numerics are identical either
+            # way, and the epoch-chunk rng streams don't depend on the flag
+            # (chunk = min(patience, epoch_count) in both cases here).
+            is_early_stopping=scenario.epoch_count > constants.PATIENCE,
             compute_dtype=getattr(scenario, "compute_dtype", "float32"),
             record_partner_val=False,
             # coalition sweeps never read the per-minibatch val history;
@@ -137,6 +148,13 @@ class CharacteristicEngine:
         self.charac_fct_values: dict[tuple, float] = {(): 0.0}
         self.increments_values = [dict() for _ in range(self.partners_count)]
         self.first_charac_fct_calls_count = 0
+        # throughput accounting over non-padding coalitions: total training
+        # epochs executed, and training samples consumed (size_i // MB * MB
+        # per active partner per epoch — the engine's static minibatch
+        # window; padded batch slots are excluded, so sample rates derived
+        # from these are conservative)
+        self.epochs_trained = 0
+        self.samples_trained = 0
         # When set, the memo cache is persisted after EVERY device batch, so
         # a crash mid-sweep loses at most one batch of trained coalitions
         # (the reference loses everything — it checkpoints nothing).
@@ -237,10 +255,15 @@ class CharacteristicEngine:
             if self._sharding is not None:
                 coal = jax.device_put(coal, self._sharding.batch_sharding)
                 rngs = jax.device_put(rngs, self._sharding.batch_sharding)
-            accs = pipe.scores(coal, rngs, self.stacked, self.val, self.test,
-                               self._coalition_rng(()))
-            for s, acc in zip(group, accs[:len(group)]):
+            accs, epochs = pipe.scores(coal, rngs, self.stacked, self.val,
+                                       self.test, self._coalition_rng(()))
+            sizes_np = np.asarray(self.stacked.sizes)
+            mbc = pipe.trainer.cfg.minibatch_count
+            for s, acc, ep in zip(group, accs[:len(group)], epochs[:len(group)]):
                 self._store(s, float(acc))
+                self.epochs_trained += int(ep)
+                self.samples_trained += int(ep) * int(
+                    sum(int(sizes_np[i]) // mbc * mbc for i in s))
             if self.autosave_path is not None:
                 self.save_cache(self.autosave_path)
             if self.progress is not None:
