@@ -149,12 +149,18 @@ class CharacteristicEngine:
         self.increments_values = [dict() for _ in range(self.partners_count)]
         self.first_charac_fct_calls_count = 0
         # throughput accounting over non-padding coalitions: total training
-        # epochs executed, and training samples consumed (size_i // MB * MB
-        # per active partner per epoch — the engine's static minibatch
-        # window; padded batch slots are excluded, so sample rates derived
-        # from these are conservative)
+        # epochs executed, and training samples consumed per active partner
+        # per epoch — size_i // MB * MB for the multi/slot trainers (the
+        # static minibatch window) but the full size_i for the single
+        # trainer (its step grid covers every valid row,
+        # mpl/engine.py _single_epoch). Padded batch slots are excluded, so
+        # sample rates derived from these are conservative.
         self.epochs_trained = 0
         self.samples_trained = 0
+        sizes_np = np.asarray(self.stacked.sizes)
+        mbc = multi_cfg.minibatch_count
+        self._epoch_samples_multi = sizes_np // mbc * mbc
+        self._epoch_samples_single = sizes_np
         # When set, the memo cache is persisted after EVERY device batch, so
         # a crash mid-sweep loses at most one batch of trained coalitions
         # (the reference loses everything — it checkpoints nothing).
@@ -257,13 +263,14 @@ class CharacteristicEngine:
                 rngs = jax.device_put(rngs, self._sharding.batch_sharding)
             accs, epochs = pipe.scores(coal, rngs, self.stacked, self.val,
                                        self.test, self._coalition_rng(()))
-            sizes_np = np.asarray(self.stacked.sizes)
-            mbc = pipe.trainer.cfg.minibatch_count
+            per_partner = (self._epoch_samples_single
+                           if pipe is self.single_pipe
+                           else self._epoch_samples_multi)
             for s, acc, ep in zip(group, accs[:len(group)], epochs[:len(group)]):
                 self._store(s, float(acc))
                 self.epochs_trained += int(ep)
                 self.samples_trained += int(ep) * int(
-                    sum(int(sizes_np[i]) // mbc * mbc for i in s))
+                    sum(int(per_partner[i]) for i in s))
             if self.autosave_path is not None:
                 self.save_cache(self.autosave_path)
             if self.progress is not None:

@@ -60,6 +60,66 @@ def test_engine_shards_over_devices():
     assert np.array_equal(vals, vals2)
 
 
+def test_engine_throughput_accounting():
+    """epochs_trained / samples_trained must count exactly the training
+    work of non-padding coalitions: epochs * sum_i(size_i // MB * MB)."""
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+    from mplc_tpu.contrib.shapley import powerset_order
+
+    sc = _logreg_scenario()
+    eng = CharacteristicEngine(sc)
+    subsets = powerset_order(3)
+    eng.evaluate(subsets)
+    # epoch_count=2 <= patience, so early stopping is a no-op and every
+    # coalition trains the full 2 epochs
+    assert eng.epochs_trained == 2 * len(subsets)
+    sizes = np.asarray(eng.stacked.sizes)
+    mbc = eng.multi_pipe.trainer.cfg.minibatch_count
+    # single trainer covers every valid row; multi trainers train the
+    # floored minibatch window (remainder rows dropped)
+    expect = 2 * sum(int(sizes[s[0]]) if len(s) == 1
+                     else sum(int(sizes[i]) // mbc * mbc for i in s)
+                     for s in subsets)
+    assert eng.samples_trained == expect
+    # the two formulas must actually differ here, or the distinction is
+    # untested — a partner size must not divide minibatch_count evenly
+    assert any(int(n) % mbc for n in sizes)
+    # memo hits train nothing
+    eng.evaluate(subsets)
+    assert eng.epochs_trained == 2 * len(subsets)
+
+
+def test_es_noop_skip_is_numerically_identical():
+    """With epoch_count <= patience the engine builds trainers with early
+    stopping off (the stop rule cannot fire; skipping it drops one val
+    eval per epoch). The scores must be bit-identical to trainers with
+    the flag forced on, as the reference always sets it
+    (contributivity.py:102-106)."""
+    import dataclasses
+
+    from mplc_tpu.contrib.engine import BatchedTrainerPipeline, CharacteristicEngine
+    from mplc_tpu.contrib.shapley import powerset_order
+    from mplc_tpu.mpl.engine import MplTrainer
+
+    subsets = powerset_order(3)
+    eng = CharacteristicEngine(_logreg_scenario())
+    assert not eng.multi_pipe.trainer.cfg.is_early_stopping
+    fast = eng.evaluate(subsets)
+
+    forced = CharacteristicEngine(_logreg_scenario())
+    forced._multi_cfg = dataclasses.replace(forced._multi_cfg,
+                                            is_early_stopping=True)
+    forced.multi_pipe = BatchedTrainerPipeline(
+        MplTrainer.get(forced.model, forced._multi_cfg),
+        forced.partners_count)
+    single_cfg = dataclasses.replace(forced.single_pipe.trainer.cfg,
+                                     is_early_stopping=True)
+    forced.single_pipe = BatchedTrainerPipeline(
+        MplTrainer.get(forced.model, single_cfg), forced.partners_count)
+    slow = forced.evaluate(subsets)
+    np.testing.assert_array_equal(fast, slow)
+
+
 @pytest.mark.slow
 def test_full_ten_partner_sweep_sharded():
     """North-star-shaped sweep at test scale: all 2^10 - 1 coalitions of a
