@@ -1,0 +1,51 @@
+#!/bin/bash
+# Run the full BASELINE.md bench suite (configs 1-5) on the real TPU,
+# waiting out tunnel outages: probe with a bounded jax.devices() before
+# each config (the axon tunnel wedges for long stretches — see
+# DESIGN_NOTES.md), re-probing every 5 min while it is down. Each config
+# is bounded by `timeout` and runs with BENCH_CPU_FALLBACK=0 — a wedge
+# mid-run aborts via bench.py's stall watchdog instead of emitting a
+# misleading CPU-fallback metric. Outputs: $OUT/config<N>.json (the one
+# metric line) and $OUT/config<N>.log (progress + throughput notes).
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-/tmp/bench_r3}
+mkdir -p "$OUT"
+
+probe() {
+    timeout 90 python - <<'EOF'
+import threading, sys
+ok = []
+def init():
+    import jax
+    ok.append(len(jax.devices()))
+t = threading.Thread(target=init, daemon=True)
+t.start(); t.join(75)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+wait_for_tunnel() {
+    until probe; do
+        echo "$(date +%T) tunnel down; retrying in 300 s"
+        sleep 300
+    done
+    echo "$(date +%T) tunnel up"
+}
+
+run_config() {
+    local c=$1; shift
+    wait_for_tunnel
+    echo "$(date +%T) running config $c"
+    timeout 5400 env BENCH_CPU_FALLBACK=0 BENCH_CONFIG="$c" "$@" \
+        python bench.py > "$OUT/config$c.json" 2> "$OUT/config$c.log"
+    local rc=$?   # before any command substitution clobbers $?
+    echo "$(date +%T) config $c exit $rc: $(cat "$OUT/config$c.json")"
+}
+
+run_config 1 BENCH_PARTNERS=10   # the north star: 1023 coalitions
+run_config 2
+run_config 3
+run_config 4
+run_config 5
+echo "$(date +%T) suite done"
