@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Measure coalitions-per-device batch width on the real chip.
+
+The north-star sweep runs tiny-CNN training steps sequentially inside one
+compiled program (80 scan steps/epoch of sub-batches <= ~128 samples) —
+the chip is latency-bound, not FLOP-bound, so widening the vmapped
+coalition batch should raise throughput almost linearly until the MXU or
+HBM saturates. This times a fixed block of same-size coalitions at
+several widths and prints s/coalition for each, steady-state (the block
+is evaluated once to compile, then re-timed on a fresh engine sharing the
+device data).
+
+Usage: python scripts/tune_coalition_cap.py [--size 5] [--block 64]
+       [--caps 16,32,64] [--partners 10] [--epochs 8]
+"""
+
+import argparse
+import os
+import sys
+import time
+from itertools import combinations, islice
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=5)
+    ap.add_argument("--block", type=int, default=64)
+    ap.add_argument("--caps", default="16,32,64")
+    ap.add_argument("--partners", type=int, default=10)
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--dataset", default=os.environ.get("BENCH_DATASET", "mnist"))
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args()
+
+    os.environ.setdefault("MPLC_TPU_SYNTH_NOISE", "0.75")
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor an explicit platform override — the axon sitecustomize pins
+        # the config value at startup, so the env var alone is ignored
+        # (same bootstrap as tests/conftest.py)
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.dirname(
+                          os.path.abspath(__file__))), ".jax_cache"))
+    import numpy as np
+
+    import bench
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+
+    caps = [int(c) for c in args.caps.split(",")]
+    if args.size > args.partners:
+        ap.error(f"--size {args.size} exceeds --partners {args.partners}")
+    # a fair comparison needs zero padding at EVERY width: the engine pads
+    # each batch to its bucket width and padded slots cost real training
+    # compute, so the block must divide evenly by every swept cap
+    import math
+    lcm = math.lcm(*caps)
+    block = -(-args.block // lcm) * lcm
+    if block != args.block:
+        print(f"block {args.block} -> {block} (multiple of lcm{tuple(caps)}="
+              f"{lcm}, so no cap pays padding)", flush=True)
+
+    sc = bench._make_scenario(args.dataset, args.partners, args.epochs, args.dtype)
+    subsets = list(islice(combinations(range(args.partners), args.size), block))
+    if len(subsets) < block:
+        ap.error(f"only {len(subsets)} size-{args.size} coalitions exist for "
+                 f"{args.partners} partners; need {block} for a padding-free "
+                 "comparison — lower --block or --caps")
+    results = {}
+    shared = None
+    for cap in caps:
+        os.environ["MPLC_TPU_COALITIONS_PER_DEVICE"] = str(cap)
+        warm = CharacteristicEngine(sc, share_data_from=shared)
+        shared = shared or warm
+        t0 = time.perf_counter()
+        warm.evaluate(subsets)          # compile + first run
+        compile_and_run = time.perf_counter() - t0
+        timed = CharacteristicEngine(sc, share_data_from=shared)
+        t0 = time.perf_counter()
+        accs = timed.evaluate(subsets)  # steady state
+        dt = time.perf_counter() - t0
+        assert np.isfinite(accs).all()
+        results[cap] = dt / len(subsets)
+        print(f"cap={cap:3d}: {dt:6.1f} s for {len(subsets)} size-{args.size} "
+              f"coalitions = {results[cap]:.3f} s/coalition "
+              f"(compile+first: {compile_and_run:.0f} s)", flush=True)
+    best = min(results, key=results.get)
+    print(f"best cap: {best} ({results[best]:.3f} s/coalition)")
+
+
+if __name__ == "__main__":
+    main()
