@@ -63,6 +63,26 @@ def test_contributivity_ordering_oracle():
     assert (sc.save_folder / "coalition_cache.json").exists()
 
 
+@pytest.mark.slow
+def test_corrupted_partner_detection_oracle():
+    """The data-plane fault-injection contract (SURVEY.md §5): corruption
+    exists to let contributivity methods DETECT bad partners. Corrupt the
+    LARGEST partner — data volume then argues for it, so only genuine
+    detection can rank it last — and assert exact Shapley does."""
+    sc = Scenario(partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+                  dataset_name="titanic",
+                  corrupted_datasets=["not_corrupted", "not_corrupted",
+                                      "corrupted"],
+                  epoch_count=6, minibatch_count=2,
+                  gradient_updates_per_pass_count=3, is_early_stopping=False,
+                  methods=["Shapley values"],
+                  experiment_path="/tmp/mplc_tpu_tests", seed=6)
+    sc.run()
+    s = sc.contributivity_list[0].contributivity_scores
+    assert s[2] < s[0] and s[2] < s[1], (
+        f"fully label-flipped 0.5-partner must rank last: {s}")
+
+
 def _cluster_mlp_dataset(n=600, num_classes=4, seed=20):
     """Tiny categorical problem: 4 Gaussian clusters, 2-layer MLP."""
     from helpers import cluster_mlp_model
