@@ -120,6 +120,56 @@ def test_es_noop_skip_is_numerically_identical():
     np.testing.assert_array_equal(fast, slow)
 
 
+def test_autosave_checkpoints_every_batch(tmp_path, monkeypatch):
+    """A crash mid-sweep must lose at most one device batch: with
+    autosave_path set, the memo cache is persisted after EVERY batch
+    (contrib/engine.py _run_batch) and a fresh engine can resume from the
+    partial file without retraining what it covers."""
+    from helpers import build_scenario
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+    from mplc_tpu.contrib.shapley import powerset_order
+
+    # one coalition per device per batch: bucket width floors at the
+    # 8-device mesh, so 5 partners make the size-2 group (10 coalitions)
+    # span TWO batches — the crash below lands mid-group, between them
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
+
+    def scenario():
+        return build_scenario(partners_count=5,
+                              amounts_per_partner=[0.1, 0.15, 0.2, 0.25, 0.3],
+                              dataset_name="titanic", epoch_count=2,
+                              gradient_updates_per_pass_count=2, seed=9)
+
+    eng = CharacteristicEngine(scenario())
+    path = tmp_path / "coalition_cache.json"
+    eng.autosave_path = path
+    checkpoints = []
+
+    class Boom(RuntimeError):
+        pass
+
+    def crash_mid_group(done, remaining, slots):
+        import json
+        checkpoints.append(len(json.loads(path.read_text())
+                               ["charac_fct_values"]))
+        if len(checkpoints) == 2:
+            raise Boom()
+
+    eng.progress = crash_mid_group
+    with pytest.raises(Boom):
+        eng.evaluate(powerset_order(5))
+    # the file survived the crash and grew STRICTLY per batch — the 2nd
+    # checkpoint is the first 8-wide batch of the size-2 group
+    assert len(checkpoints) == 2 and checkpoints[0] < checkpoints[1]
+    assert eng.first_charac_fct_calls_count == 5 + 8
+    # a fresh engine resumes from the partial file without retraining
+    resumed = CharacteristicEngine(scenario())
+    resumed.load_cache(path)
+    assert resumed.first_charac_fct_calls_count == 5 + 8
+    resumed.evaluate(powerset_order(5))
+    assert resumed.first_charac_fct_calls_count == 31  # only the rest trained
+
+
 @pytest.mark.slow
 def test_full_ten_partner_sweep_sharded():
     """North-star-shaped sweep at test scale: all 2^10 - 1 coalitions of a
