@@ -31,6 +31,17 @@ def cluster_mlp_model(num_classes=4, in_features=16, hidden=32):
                  lambda: optax.adam(2e-2))
 
 
+def make_cluster_data(rng, n, centers):
+    """(x, one-hot y) for the Gaussian-cluster categorical problem: one
+    draw per sample from `centers[y] + N(0, 1)`."""
+    import numpy as np
+
+    num_classes, features = centers.shape
+    y = rng.integers(0, num_classes, n)
+    x = (centers[y] + rng.normal(size=(n, features))).astype(np.float32)
+    return x, np.eye(num_classes, dtype=np.float32)[y]
+
+
 def build_scenario(**overrides):
     """A prepped 3-partner scenario; pass `dataset=` or `dataset_name=`
     plus any Scenario kwarg to override the quick defaults."""

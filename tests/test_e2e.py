@@ -21,7 +21,7 @@ from pathlib import Path
 import numpy as np
 import pytest
 
-from mplc_tpu.data.datasets import Dataset, to_categorical
+from mplc_tpu.data.datasets import Dataset
 from mplc_tpu.scenario import Scenario
 
 REPO = Path(__file__).resolve().parents[1]
@@ -85,16 +85,14 @@ def test_corrupted_partner_detection_oracle():
 
 def _cluster_mlp_dataset(n=600, num_classes=4, seed=20):
     """Tiny categorical problem: 4 Gaussian clusters, 2-layer MLP."""
-    from helpers import cluster_mlp_model
+    from helpers import cluster_mlp_model, make_cluster_data
 
     mlp = cluster_mlp_model(num_classes)
     rng = np.random.default_rng(seed)
     centers = rng.normal(size=(num_classes, 16)).astype(np.float32) * 2.5
 
     def make(m):
-        y = rng.integers(0, num_classes, m)
-        x = centers[y] + rng.normal(size=(m, 16)).astype(np.float32)
-        return x.astype(np.float32), to_categorical(y, num_classes)
+        return make_cluster_data(rng, m, centers)
 
     x, y = make(n)
     xt, yt = make(n // 3)

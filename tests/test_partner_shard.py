@@ -1,4 +1,4 @@
-"""Partner-axis sharding: sharded fedavg must equal the unsharded run.
+"""Partner-axis sharding: sharded fedavg/lflip must equal the unsharded run.
 
 The per-partner RNG streams are keyed by global partner index, so the only
 difference between a sharded and an unsharded run is the reduction order of
@@ -73,6 +73,61 @@ def test_partner_sharded_matches_unsharded(eight_partner_problem):
     # val histories computed on every shard must agree with the reference
     assert np.allclose(np.asarray(state.val_loss_h),
                        np.asarray(sstate.val_loss_h), atol=1e-4)
+
+
+def test_partner_sharded_lflip_matches_unsharded():
+    """lflip is the other partner-parallel approach: its per-partner theta
+    ([P, K, K]) and theta history ([E, P, K, K]) shard over `part`
+    (partner_shard.train_state_specs lflip=True) and the EM draws are keyed
+    by global partner index — the sharded run must reproduce the unsharded
+    params, score, AND theta trajectory."""
+    from helpers import cluster_mlp_model, make_cluster_data
+
+    mlp = cluster_mlp_model(4)
+    rng_np = np.random.default_rng(7)
+    centers = rng_np.normal(size=(4, 16)).astype(np.float32) * 2.0
+
+    def make(n):
+        return make_cluster_data(rng_np, n, centers)
+
+    partners = []
+    for i, n in enumerate([40, 60, 40, 60, 40, 60, 40, 60]):
+        p = Partner(i)
+        p.x_train, p.y_train = make(n)
+        partners.append(p)
+    stacked = StackedPartners.build(partners, 4)
+    val = EvalSet(*stack_eval_set(*make(80), 4, 128))
+    test = EvalSet(*stack_eval_set(*make(80), 4, 128))
+
+    def cfg(partner_axis=None):
+        return TrainConfig(approach="lflip", aggregator="data-volume",
+                           epoch_count=2, minibatch_count=2,
+                           gradient_updates_per_pass=2,
+                           is_early_stopping=False, record_partner_val=False,
+                           partner_axis=partner_axis)
+
+    coal_mask = jnp.array([1, 1, 0, 1, 1, 1, 0, 1], jnp.float32)
+    rng = jax.random.PRNGKey(0)
+
+    tr = MplTrainer(mlp, cfg())
+    state = tr.init_state(rng, 8)
+    state = tr.jit_epoch_chunk(state, stacked, val, coal_mask, rng, n_epochs=2)
+    _, acc_ref = tr.jit_finalize(state, test)
+
+    mesh = make_mesh(jax.devices()[:4], "part")
+    sharded = PartnerShardedTrainer(MplTrainer(mlp, cfg("part")), mesh)
+    sstate = sharded.init_state(rng, 8)
+    sstate = sharded.epoch_chunk(sstate, stacked, val, coal_mask, rng, 2)
+    _, acc_sh = sharded.finalize(sstate, test)
+
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(sstate.params)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    assert np.isclose(float(acc_ref), float(acc_sh), atol=1e-5)
+    assert np.allclose(np.asarray(state.theta), np.asarray(sstate.theta),
+                       atol=1e-5)
+    assert np.allclose(np.asarray(state.theta_h), np.asarray(sstate.theta_h),
+                       atol=1e-5, equal_nan=True)
 
 
 def test_partner_sharding_rejects_sequential():
