@@ -53,16 +53,32 @@ def _main(argv=None):
 
     config_file = args.file or DEFAULT_CONFIG_FILE
     logger.info(f"Using config file: {config_file}")
-    config = utils.get_config_from_file(config_file)
+    # Multi-host farm-out: the grid axis shares nothing between scenarios,
+    # so host I of N simply owns slice I::N (global scenario ids preserved,
+    # per-shard results file in ONE shared deterministic folder —
+    # concatenate when all hosts finish). argparse already validated the
+    # spec, before any filesystem side effect.
+    shard = args.grid_shard
+    config = utils.get_config_from_file(config_file, shard=shard)
 
     scenario_params_list = utils.get_scenario_params_list(
         config["scenario_params_list"])
     experiment_path = config["experiment_path"]
     n_repeats = config["n_repeats"]
 
-    validate_scenario_list(scenario_params_list, experiment_path)
+    indexed_scenarios = list(enumerate(scenario_params_list))
+    results_name = "results.csv"
+    if shard is not None:
+        shard_i, shard_n = shard
+        indexed_scenarios = indexed_scenarios[shard_i::shard_n]
+        results_name = f"results_shard{shard_i}.csv"
+        logger.info(f"Grid shard {shard_i}/{shard_n}: running "
+                    f"{len(indexed_scenarios)} of {len(scenario_params_list)} "
+                    "scenarios")
 
-    for scenario_id, scenario_params in enumerate(scenario_params_list):
+    validate_scenario_list([p for _, p in indexed_scenarios], experiment_path)
+
+    for scenario_id, scenario_params in indexed_scenarios:
         logger.info(f"Scenario {scenario_id + 1}/{len(scenario_params_list)}: "
                     f"{scenario_params}")
 
@@ -70,7 +86,7 @@ def _main(argv=None):
 
     for i in range(n_repeats):
         logger.info(f"Repeat {i + 1}/{n_repeats}")
-        for scenario_id, scenario_params in enumerate(scenario_params_list):
+        for scenario_id, scenario_params in indexed_scenarios:
             logger.info(f"Scenario {scenario_id + 1}/{len(scenario_params_list)}")
             current_scenario = Scenario(**scenario_params,
                                         experiment_path=experiment_path,
@@ -82,7 +98,7 @@ def _main(argv=None):
             df_results["random_state"] = i
             df_results["scenario_id"] = scenario_id
 
-            results_path = experiment_path / "results.csv"
+            results_path = experiment_path / results_name
             with open(results_path, "a") as f:
                 df_results.to_csv(f, header=f.tell() == 0, index=False)
             logger.info(f"Results saved to {os.path.relpath(results_path)}")

@@ -95,24 +95,38 @@ def get_scenario_params_list(config):
     return scenarios
 
 
-def init_result_folder(yaml_filepath, cfg):
+def init_result_folder(yaml_filepath, cfg, shard=None):
+    """Create the experiment folder. Unsharded runs get the reference's
+    timestamped-unique folder. Sharded runs (`--grid-shard I/N`) need the
+    OPPOSITE: N concurrently-launched hosts must all land in the SAME
+    folder (on a shared filesystem) so the per-shard results files end up
+    side by side — so the folder name is deterministic
+    (<name>_shardedN), created with exist_ok=True (no launch race), and
+    the config copy is per-shard to avoid concurrent writes to one file."""
     logger.info("Init result folder")
-    now_str = datetime.datetime.now().strftime("%Y-%m-%d_%Hh%M")
-    full_experiment_name = cfg["experiment_name"] + "_" + now_str
-    experiment_path = Path.cwd() / constants.EXPERIMENTS_FOLDER_NAME / full_experiment_name
-    while experiment_path.exists():
-        logger.warning(f"Experiment folder {experiment_path} already exists")
-        experiment_path = Path(str(experiment_path) + "_bis")
-    experiment_path.mkdir(parents=True, exist_ok=False)
+    root = Path.cwd() / constants.EXPERIMENTS_FOLDER_NAME
+    if shard is not None:
+        shard_i, shard_n = shard
+        experiment_path = root / f"{cfg['experiment_name']}_sharded{shard_n}"
+        experiment_path.mkdir(parents=True, exist_ok=True)
+        copyfile(yaml_filepath,
+                 experiment_path / f"config_shard{shard_i}.yml")
+    else:
+        now_str = datetime.datetime.now().strftime("%Y-%m-%d_%Hh%M")
+        experiment_path = root / (cfg["experiment_name"] + "_" + now_str)
+        while experiment_path.exists():
+            logger.warning(f"Experiment folder {experiment_path} already exists")
+            experiment_path = Path(str(experiment_path) + "_bis")
+        experiment_path.mkdir(parents=True, exist_ok=False)
+        copyfile(yaml_filepath, experiment_path / Path(yaml_filepath).name)
     cfg["experiment_path"] = experiment_path
-    copyfile(yaml_filepath, experiment_path / Path(yaml_filepath).name)
     logger.info(f"Experiment folder {experiment_path} created.")
     return cfg
 
 
-def get_config_from_file(config_filepath):
+def get_config_from_file(config_filepath, shard=None):
     config = load_cfg(config_filepath)
-    config = init_result_folder(config_filepath, config)
+    config = init_result_folder(config_filepath, config, shard=shard)
     return config
 
 
@@ -121,7 +135,32 @@ def parse_command_line_arguments(argv=None):
     parser.add_argument("-f", "--file", help="input config file")
     parser.add_argument("-v", "--verbose", help="verbose output",
                         action="store_true")
+    parser.add_argument(
+        "--grid-shard", metavar="I/N", default=None, type=parse_grid_shard,
+        help="run only scenarios I::N of the expanded grid (0-based). The "
+             "grid axis is embarrassingly parallel — this is the multi-HOST "
+             "scale-out: launch N processes/hosts with I=0..N-1; they share "
+             "one deterministic experiment folder (<name>_shardedN) and "
+             "each writes its own results_shardI.csv; concatenate "
+             "afterwards. (The reference has no multi-host story; within "
+             "one host, coalition/partner parallelism already uses every "
+             "chip over ICI.)")
     return parser.parse_args(argv)
+
+
+def parse_grid_shard(spec):
+    """'I/N' -> (i, n) with 0 <= i < n. Argparse `type` callable: raising
+    ArgumentTypeError makes a malformed spec a usage error BEFORE any
+    filesystem side effect (folder creation happens later in main)."""
+    try:
+        i, n = (int(part) for part in spec.split("/"))
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--grid-shard must look like I/N, got {spec!r}")
+    if not 0 <= i < n:
+        raise argparse.ArgumentTypeError(
+            f"--grid-shard needs 0 <= I < N, got {spec!r}")
+    return i, n
 
 
 def init_logger(debug=False):

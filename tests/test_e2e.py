@@ -158,3 +158,63 @@ def test_cli_end_to_end(tmp_path):
     df = pd.read_csv(results)
     assert (df["mpl_test_score"] > 0.5).all()
     assert (df["contributivity_method"] == "Independent scores raw").any()
+
+
+def test_cli_grid_shard_farm_out(tmp_path):
+    """Multi-host scale-out of the scenario grid: `--grid-shard I/N` gives
+    host I the slice I::N with GLOBAL scenario ids; all shards share ONE
+    deterministic experiment folder (<name>_shardedN — concurrent launches
+    must not race on folder creation) and each writes its own
+    results_shardI.csv — the shards' union covers the grid exactly."""
+    cfg = tmp_path / "cfg.yml"
+    cfg.write_text(
+        "experiment_name: shard_test\n"
+        "n_repeats: 1\n"
+        "scenario_params_list:\n"
+        "  - dataset_name:\n"
+        "      titanic: null\n"
+        "    partners_count: [2]\n"
+        "    amounts_per_partner: [[0.4, 0.6]]\n"
+        "    samples_split_option: [['basic', 'random']]\n"
+        "    multi_partner_learning_approach: ['fedavg']\n"
+        "    aggregation_weighting: ['uniform', 'data-volume', 'local-score']\n"
+        "    epoch_count: [2]\n"
+        "    minibatch_count: [2]\n"
+        "    gradient_updates_per_pass_count: [2]\n"
+        "    is_early_stopping: [False]\n"
+        "    methods: [['Independent scores']]\n")
+    env = {"MPLC_TPU_SYNTH_SCALE": "0.01", "JAX_PLATFORMS": "cpu",
+           "JAX_COMPILATION_CACHE_DIR": str(REPO / ".jax_cache"),
+           "PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin:/usr/local/bin",
+           "HOME": "/root"}
+    for shard in ("0/2", "1/2"):
+        res = subprocess.run(
+            [sys.executable, str(REPO / "main.py"), "-f", str(cfg),
+             "--grid-shard", shard],
+            cwd=tmp_path, env=env, capture_output=True, text=True,
+            timeout=1200)
+        assert res.returncode == 0, res.stderr[-3000:]
+    import pandas as pd
+    shared = tmp_path / "experiments" / "shard_test_sharded2"
+    assert shared.is_dir(), "shards must share one deterministic folder"
+    assert not list((tmp_path / "experiments").glob("shard_test_2*")), \
+        "sharded runs must not create timestamped folders"
+    ids = {}
+    for i in (0, 1):
+        f = shared / f"results_shard{i}.csv"
+        assert f.exists(), f"shard {i} wrote no results"
+        assert (shared / f"config_shard{i}.yml").exists()
+        ids[i] = set(pd.read_csv(f)["scenario_id"])
+    # the 3-scenario grid (aggregation axis) is covered exactly once, with
+    # GLOBAL ids: shard 0 owns {0, 2}, shard 1 owns {1}
+    assert ids[0] == {0, 2} and ids[1] == {1}
+    # a malformed spec is an argparse usage error BEFORE any filesystem
+    # side effect — no junk experiment folder appears
+    before = sorted((tmp_path / "experiments").iterdir())
+    res = subprocess.run(
+        [sys.executable, str(REPO / "main.py"), "-f", str(cfg),
+         "--grid-shard", "2/2"],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode != 0
+    assert "usage" in res.stderr.lower()
+    assert sorted((tmp_path / "experiments").iterdir()) == before
