@@ -102,6 +102,11 @@ def _main(argv=None):
             with open(results_path, "a") as f:
                 df_results.to_csv(f, header=f.tell() == 0, index=False)
             logger.info(f"Results saved to {os.path.relpath(results_path)}")
+    if shard is not None:
+        # completion marker for scripts/merge_shards.py: csv presence can't
+        # signal "host finished" (the file appears after the first scenario
+        # and a shard whose slice is empty never writes one)
+        (experiment_path / f".shard{shard[0]}.done").touch()
     return 0
 
 

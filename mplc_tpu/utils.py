@@ -141,10 +141,10 @@ def parse_command_line_arguments(argv=None):
              "grid axis is embarrassingly parallel — this is the multi-HOST "
              "scale-out: launch N processes/hosts with I=0..N-1; they share "
              "one deterministic experiment folder (<name>_shardedN) and "
-             "each writes its own results_shardI.csv; concatenate "
-             "afterwards. (The reference has no multi-host story; within "
-             "one host, coalition/partner parallelism already uses every "
-             "chip over ICI.)")
+             "each writes its own results_shardI.csv; stitch with "
+             "scripts/merge_shards.py when all finish. (The reference has "
+             "no multi-host story; within one host, coalition/partner "
+             "parallelism already uses every chip over ICI.)")
     return parser.parse_args(argv)
 
 

@@ -204,10 +204,29 @@ def test_cli_grid_shard_farm_out(tmp_path):
         f = shared / f"results_shard{i}.csv"
         assert f.exists(), f"shard {i} wrote no results"
         assert (shared / f"config_shard{i}.yml").exists()
+        assert (shared / f".shard{i}.done").exists(), \
+            "finished host must leave its completion marker"
         ids[i] = set(pd.read_csv(f)["scenario_id"])
     # the 3-scenario grid (aggregation axis) is covered exactly once, with
     # GLOBAL ids: shard 0 owns {0, 2}, shard 1 owns {1}
     assert ids[0] == {0, 2} and ids[1] == {1}
+    # merge refuses while a host looks unfinished (marker missing), then
+    # stitches the standard results.csv and retires the shard files so the
+    # notebooks' results*.csv glob can't double-count
+    marker = shared / ".shard1.done"
+    marker.unlink()
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "merge_shards.py"),
+         str(shared)], capture_output=True, text=True, timeout=300)
+    assert res.returncode != 0 and "missing" in res.stderr
+    marker.touch()
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "merge_shards.py"),
+         str(shared)], capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr[-2000:]
+    merged = pd.read_csv(shared / "results.csv")
+    assert sorted(set(merged["scenario_id"])) == [0, 1, 2]
+    assert not list(shared.glob("results_shard*.csv"))   # retired to *.merged
     # a malformed spec is an argparse usage error BEFORE any filesystem
     # side effect — no junk experiment folder appears
     before = sorted((tmp_path / "experiments").iterdir())
