@@ -76,6 +76,14 @@ def _main(argv=None):
                     f"{len(indexed_scenarios)} of {len(scenario_params_list)} "
                     "scenarios")
 
+    if shard is not None:
+        # a re-run reuses the deterministic sharded folder — a stale done
+        # marker from a previous run must not let merge_shards.py merge
+        # THIS run's partial csv, and appending to the previous run's csv
+        # would silently duplicate its rows
+        (experiment_path / f".shard{shard[0]}.done").unlink(missing_ok=True)
+        (experiment_path / results_name).unlink(missing_ok=True)
+
     validate_scenario_list([p for _, p in indexed_scenarios], experiment_path)
 
     for scenario_id, scenario_params in indexed_scenarios:

@@ -52,14 +52,21 @@ def main(argv=None):
         dm = re.search(r"\.shard(\d+)\.done$", f)
         if dm:
             done.add(int(dm.group(1)))
-    if expected is not None and not args.force:
-        missing = sorted(set(range(expected)) - done)
+    if not args.force:
+        if expected is not None:
+            required = set(range(expected))
+        else:
+            # folder was renamed/copied and lost its _shardedN suffix: we
+            # can't know N, but every shard csv present must at least have
+            # its own done marker or its host may still be appending
+            required = {int(re.search(r"results_shard(\d+)\.csv$", f).group(1))
+                        for f in files}
+        missing = sorted(required - done)
         if missing:
-            ap.error(f"{args.folder} expects {expected} finished shards but "
-                     f"done markers are missing for {missing} — those hosts "
-                     "are still running or crashed (csv presence is not "
-                     "completion: rows append as scenarios finish). "
-                     "--force to merge anyway")
+            ap.error(f"{args.folder} has no done markers for shards "
+                     f"{missing} — those hosts are still running or crashed "
+                     "(csv presence is not completion: rows append as "
+                     "scenarios finish). --force to merge anyway")
     df = pd.concat([pd.read_csv(f) for f in files], ignore_index=True)
     sort_cols = [c for c in ("scenario_id", "random_state") if c in df.columns]
     if sort_cols:
@@ -69,6 +76,12 @@ def main(argv=None):
     if not args.keep:
         for f in files:
             os.replace(f, f + ".merged")
+        # retire the markers with the csvs: a later re-run into this
+        # deterministic folder must not inherit stale completion signals
+        for i in sorted(done):
+            marker = os.path.join(args.folder, f".shard{i}.done")
+            if os.path.exists(marker):
+                os.remove(marker)
     print(f"merged {len(files)} shard files, {len(df)} rows -> {out}"
           + ("" if args.keep else " (shard files renamed to *.merged)"))
     return 0

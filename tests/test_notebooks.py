@@ -1,0 +1,49 @@
+"""Execute every analysis notebook's code cells end to end.
+
+The notebooks are user-facing deliverables (reference ships runnable
+analysis notebooks, /root/reference/notebooks/); nothing else would catch
+API rot in them. Cells run with the kernel cwd at notebooks/ — the same
+convention a real jupyter launch uses — on the CPU mesh, with matplotlib
+headless.
+"""
+
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+NOTEBOOKS_DIR = Path(__file__).resolve().parents[1] / "notebooks"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ["results_analysis.ipynb",
+                                  "mpl_analysis.ipynb",
+                                  "method_comparison.ipynb",
+                                  "run_experiment_on_tpu.ipynb"])
+def test_notebook_code_cells_execute(name, monkeypatch):
+    monkeypatch.setenv("MPLBACKEND", "Agg")           # headless plotting
+    monkeypatch.setenv("MPLC_TPU_SYNTH_SCALE", "0.02")
+    monkeypatch.chdir(NOTEBOOKS_DIR)
+    nb = json.loads((NOTEBOOKS_DIR / name).read_text())
+    ns = {}
+    for i, cell in enumerate(nb["cells"]):
+        if cell["cell_type"] != "code":
+            continue
+        # strip IPython magics (%matplotlib inline, !pip ...) — they are
+        # kernel directives, not Python
+        src = "".join(l for l in cell["source"]
+                      if not l.lstrip().startswith(("%", "!")))
+        try:
+            exec(compile(src, f"{name}:cell{i}", "exec"), ns)
+        except Exception as e:
+            pytest.fail(f"{name} cell {i} raised {e!r}\n--- cell source ---\n"
+                        f"{src[:1500]}")
+
+
+def test_notebooks_are_valid_json():
+    names = sorted(p.name for p in NOTEBOOKS_DIR.glob("*.ipynb"))
+    assert len(names) >= 4
+    for p in NOTEBOOKS_DIR.glob("**/*.ipynb"):
+        nb = json.loads(p.read_text())
+        assert nb.get("cells"), f"{p} has no cells"
