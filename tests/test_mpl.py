@@ -76,10 +76,16 @@ def test_coalition_mask_excludes_partner(small_logreg_problem):
         assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
-def test_batched_coalitions_match_individual(small_logreg_problem):
-    """vmapped mask batch must give the same scores as one-at-a-time runs."""
+@pytest.mark.parametrize("approach", ["fedavg", "seq-pure", "seqavg",
+                                      "seq-with-final-agg"])
+def test_batched_coalitions_match_individual(small_logreg_problem, approach):
+    """vmapped mask batch must give the same scores as one-at-a-time runs —
+    the seq family runs through the same vmapped multi pipe. (lflip, the
+    remaining sweepable approach, gets its own categorical-model case
+    below: the binary logreg fixture has num_outputs=1, degenerate for a
+    KxK flip matrix.)"""
     stacked, val, test = small_logreg_problem
-    cfg = TrainConfig(approach="fedavg", aggregator="uniform", epoch_count=2,
+    cfg = TrainConfig(approach=approach, aggregator="uniform", epoch_count=2,
                       minibatch_count=2, gradient_updates_per_pass=2,
                       is_early_stopping=False, record_partner_val=False)
     tr = MplTrainer(TITANIC_LOGREG, cfg)
@@ -99,6 +105,52 @@ def test_batched_coalitions_match_individual(small_logreg_problem):
         state = run(state, stacked, val, masks[i], jax.random.PRNGKey(5), n_epochs=2)
         _, acc = jax.jit(tr.finalize)(state, test)
         assert np.isclose(float(acc), float(batch_accs[i]), atol=1e-5)
+
+
+def test_batched_coalitions_match_individual_lflip():
+    """lflip batched-coalition parity on a categorical model: theta is
+    vmapped per-coalition state alongside params, so a regression specific
+    to the batched lflip path would be invisible to the logreg cases."""
+    from helpers import cluster_mlp_model, make_cluster_data
+
+    mlp = cluster_mlp_model(4)
+    rng_np = np.random.default_rng(11)
+    centers = rng_np.normal(size=(4, 16)).astype(np.float32) * 2.0
+    from mplc_tpu.data.partition import StackedPartners, stack_eval_set
+    from mplc_tpu.data.partner import Partner
+
+    partners = []
+    for i, n in enumerate([40, 60, 50]):
+        p = Partner(i)
+        p.x_train, p.y_train = make_cluster_data(rng_np, n, centers)
+        partners.append(p)
+    stacked = StackedPartners.build(partners, 4)
+    val = EvalSet(*stack_eval_set(*make_cluster_data(rng_np, 60, centers), 4, 64))
+    test = EvalSet(*stack_eval_set(*make_cluster_data(rng_np, 60, centers), 4, 64))
+
+    cfg = TrainConfig(approach="lflip", aggregator="uniform", epoch_count=2,
+                      minibatch_count=2, gradient_updates_per_pass=2,
+                      is_early_stopping=False, record_partner_val=False)
+    tr = MplTrainer(mlp, cfg)
+    masks = jnp.array([[1, 1, 0], [0, 1, 1], [1, 1, 1]], jnp.float32)
+    rngs = jnp.stack([jax.random.PRNGKey(5)] * 3)
+
+    binit = jax.jit(jax.vmap(lambda r: tr.init_state(r, 3)))
+    brun = jax.jit(jax.vmap(tr.epoch_chunk, in_axes=(0, None, None, 0, 0, None)),
+                   static_argnames=("n_epochs",))
+    bfin = jax.jit(jax.vmap(tr.finalize, in_axes=(0, None)))
+    bstate = brun(binit(rngs), stacked, val, masks, rngs, 2)
+    _, batch_accs = bfin(bstate, test)
+
+    for i in range(3):
+        state = tr.init_state(jax.random.PRNGKey(5), 3)
+        run = jax.jit(tr.epoch_chunk, static_argnames=("n_epochs",))
+        state = run(state, stacked, val, masks[i], jax.random.PRNGKey(5), n_epochs=2)
+        _, acc = jax.jit(tr.finalize)(state, test)
+        assert np.isclose(float(acc), float(batch_accs[i]), atol=1e-5)
+        # per-partner theta matches too (inactive partners keep theta0)
+        np.testing.assert_allclose(np.asarray(bstate.theta[i]),
+                                   np.asarray(state.theta), atol=1e-5)
 
 
 def test_early_stopping_freezes(small_logreg_problem):
