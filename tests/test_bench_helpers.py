@@ -49,6 +49,25 @@ def test_progress_callback_reports_and_beats(capsys):
     assert bench._last_beat > 0.0, "progress callback must feed the watchdog"
 
 
+@pytest.mark.slow
+def test_bench_method_driver_end_to_end(capsys):
+    """The configs-2..5 code path (_bench_method: warm engine -> fresh
+    engine sharing device data -> compute_contributivity -> one metric
+    line + throughput note), driven on the fast titanic family. Configs
+    2-5 differ from this run only in dataset/model and method args."""
+    bench._bench_method("titanic", 3, "TMCS", epochs=2, dtype="float32",
+                        extra_methods=("Independent scores",))
+    out = capsys.readouterr()
+    import json
+    lines = [l for l in out.out.splitlines() if l.strip().startswith("{")]
+    assert len(lines) == 1, f"exactly one metric line expected: {out.out!r}"
+    metric = json.loads(lines[0])
+    assert metric["metric"].startswith("tmcs_titanic_3partners")
+    assert metric["value"] > 0 and metric["unit"] == "s"
+    assert "TMCS scores:" in out.err
+    assert "throughput:" in out.err
+
+
 def test_devices_deadline_returns_none_on_hang(monkeypatch):
     """A backend init that never returns yields None, not a hang."""
     monkeypatch.setenv("BENCH_INIT_TIMEOUT", "0.2")
