@@ -162,7 +162,8 @@ def _spawn_cpu_fallback() -> int:
     # and a tight accelerator stall/init timeout would re-arm the child's
     # watchdog, which is deliberately off on CPU.
     for knob in ("BENCH_DTYPE", "MPLC_TPU_COALITIONS_PER_DEVICE",
-                 "MPLC_TPU_NO_SLOTS", "MPLC_TPU_SYNTH_SCALE",
+                 "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
+                 "MPLC_TPU_SYNTH_SCALE",
                  "BENCH_STALL_TIMEOUT", "BENCH_INIT_TIMEOUT"):
         env.pop(knob, None)
     env.update(

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from .. import constants
 from ..data.partition import StackedPartners, stack_eval_set
 from ..mpl.engine import EvalSet, MplTrainer, TrainConfig
-from ..parallel.mesh import coalition_sharding
+from ..parallel.mesh import coalition_sharding, make_2d_mesh
 
 
 def _bucket_size(n: int, n_dev: int, cap_per_dev: int) -> int:
@@ -78,6 +78,75 @@ class BatchedTrainerPipeline:
         _, accs = self._fin(state, test)
         return (np.asarray(jax.device_get(accs)),
                 np.asarray(jax.device_get(state.nb_epochs_done)))
+
+
+class Batched2DTrainerPipeline(BatchedTrainerPipeline):
+    """Coalition-batched training on a 2-D [coal, part] mesh: the mask
+    batch shards over `coal` AND the partner dimension shards over `part`
+    inside every coalition training (shard_map; per-round aggregation is
+    one psum over `part` — mplc_tpu/parallel/partner_shard.py). For large
+    partner counts where one device shouldn't hold the whole stacked
+    partner axis; the masked (non-slot) path, since slot execution rebinds
+    partners dynamically and can't be statically partner-sharded.
+
+    RNG streams are keyed by GLOBAL partner index throughout the trainer,
+    so results match the unsharded masked path to float tolerance. The
+    early-stopping chunk loop is inherited: only `_init`/`_run`/`_fin`
+    are replaced with shard_map'd equivalents."""
+
+    def __init__(self, trainer: MplTrainer, partners_count: int, mesh):
+        from functools import partial
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..mpl.engine import TrainState
+        from ..parallel.partner_shard import (shard_map_norep, stacked_specs,
+                                              train_state_specs)
+
+        cfg = trainer.cfg
+        assert cfg.partner_axis == "part"
+        self.trainer = trainer
+        self.partners_count = partners_count
+        self.mesh = mesh
+        self.coal_devices = mesh.shape["coal"]
+        self.part_shards = mesh.shape["part"]
+        self._local_partners = partners_count // self.part_shards
+
+        st = train_state_specs("part", lflip=cfg.approach == "lflip")
+        # prefix every leaf's spec with the coalition-batch axis
+        st_b = TrainState(*[P("coal", *s) for s in st])
+        sp = stacked_specs("part")
+
+        def init_fn(rngs):
+            return jax.vmap(lambda r: trainer.init_state(
+                r, self._local_partners))(rngs)
+
+        init2d = jax.jit(shard_map_norep(
+            init_fn, mesh=mesh, in_specs=(P("coal"),), out_specs=st_b))
+        # base-signature shim: partners_count is baked into init_fn
+        self._init = lambda rngs, _partners_count: init2d(rngs)
+
+        def run_fn(state, stacked, val, masks, rngs, n_epochs):
+            return jax.vmap(trainer.epoch_chunk,
+                            in_axes=(0, None, None, 0, 0, None))(
+                state, stacked, val, masks, rngs, n_epochs)
+
+        run_cache = {}
+
+        def run(state, stacked, val, masks, rngs, n_epochs):
+            if n_epochs not in run_cache:
+                run_cache[n_epochs] = jax.jit(shard_map_norep(
+                    partial(run_fn, n_epochs=n_epochs), mesh=mesh,
+                    in_specs=(st_b, sp, P(), P("coal", "part"), P("coal")),
+                    out_specs=st_b))
+            return run_cache[n_epochs](state, stacked, val, masks, rngs)
+
+        self._run = run
+        # params are replicated over `part` after aggregation; finalize is
+        # an ordinary vmapped eval, GSPMD-partitioned over the coal axis
+        self._fin = jax.jit(jax.vmap(trainer.finalize, in_axes=(0, None)))
+        self.batch_sharding = NamedSharding(mesh, P("coal", "part"))
+        self.rng_sharding = NamedSharding(mesh, P("coal"))
 
 
 class CharacteristicEngine:
@@ -144,6 +213,31 @@ class CharacteristicEngine:
         self._use_slots = (multi_cfg.approach == "fedavg"
                            and os.environ.get("MPLC_TPU_NO_SLOTS") != "1")
         self._slot_pipes: dict[int, BatchedTrainerPipeline] = {}
+
+        # 2-D [coal, part] mode (MPLC_TPU_PARTNER_SHARDS=p): shard the
+        # partner dimension over p devices inside every coalition training,
+        # coalitions over the remaining n_dev/p. For partner counts / models
+        # too large for one device's HBM; numerics identical to the 1-D
+        # masked path (global-index rng keying).
+        self._pipe2d = None
+        _env = os.environ.get("MPLC_TPU_PARTNER_SHARDS")
+        part_shards = int(_env) if _env else 1
+        if part_shards > 1:
+            n_dev = len(jax.devices())
+            if multi_cfg.approach not in ("fedavg", "lflip"):
+                raise ValueError(
+                    "MPLC_TPU_PARTNER_SHARDS requires a partner-parallel "
+                    f"approach (fedavg/lflip), got {multi_cfg.approach!r}")
+            if self.partners_count % part_shards or n_dev % part_shards:
+                raise ValueError(
+                    f"MPLC_TPU_PARTNER_SHARDS={part_shards} must divide both "
+                    f"the partner count ({self.partners_count}) and the "
+                    f"device count ({n_dev})")
+            mesh = make_2d_mesh(n_dev // part_shards, part_shards)
+            cfg2d = dataclasses.replace(multi_cfg, partner_axis="part")
+            self._pipe2d = Batched2DTrainerPipeline(
+                MplTrainer.get(self.model, cfg2d), self.partners_count, mesh)
+            self._use_slots = False
 
         self.charac_fct_values: dict[tuple, float] = {(): 0.0}
         self.increments_values = [dict() for _ in range(self.partners_count)]
@@ -234,10 +328,16 @@ class CharacteristicEngine:
                 MplTrainer.get(self.model, cfg), self.partners_count)
         return self._slot_pipes[k]
 
-    def _run_batch(self, subsets: list[tuple], pipe: BatchedTrainerPipeline,
+    def _run_batch(self, subsets: list[tuple], pipe,
                    slot_count: int | None = None) -> None:
-        n_dev = max(self._sharding.num_devices if self._sharding else 1, 1)
-        cap = self._device_batch_cap(slot_count)
+        if getattr(pipe, "coal_devices", None):
+            n_dev = pipe.coal_devices          # 2-D mesh: coal axis only
+            # each device holds only partners_count / part_shards partner
+            # model copies — cap on the LOCAL count, not the global one
+            cap = self._device_batch_cap(pipe._local_partners)
+        else:
+            n_dev = max(self._sharding.num_devices if self._sharding else 1, 1)
+            cap = self._device_batch_cap(slot_count)
         # ONE bucket width for the whole call (the tail group pads up to it
         # rather than compiling its own smaller-width program) — so a warm-up
         # pass over min(len, n_dev*cap) subsets per size compiles exactly
@@ -258,7 +358,10 @@ class CharacteristicEngine:
                     coal[j, list(s)] = 1.0
             rngs = jnp.stack([self._coalition_rng(s) for s in padded])
             coal = jnp.asarray(coal)
-            if self._sharding is not None:
+            if getattr(pipe, "batch_sharding", None) is not None:
+                coal = jax.device_put(coal, pipe.batch_sharding)
+                rngs = jax.device_put(rngs, pipe.rng_sharding)
+            elif self._sharding is not None:
                 coal = jax.device_put(coal, self._sharding.batch_sharding)
                 rngs = jax.device_put(rngs, self._sharding.batch_sharding)
             accs, epochs = pipe.scores(coal, rngs, self.stacked, self.val,
@@ -275,6 +378,51 @@ class CharacteristicEngine:
                 self.save_cache(self.autosave_path)
             if self.progress is not None:
                 self.progress(len(group), len(subsets) - i, slot_count)
+
+    def _run_singles_sliced(self, singles: list[tuple]) -> None:
+        """2-D mode singletons: a 1-partner coalition touches only its own
+        partner's rows, so slice a [b, Nmax, ...] batch of just the needed
+        partners instead of replicating the whole stacked axis per device
+        (which the 2-D mode exists to avoid). The single trainer's rng
+        streams are per-coalition, not partner-row-indexed, so the slice
+        trains identically; the mask is the identity (coalition j owns
+        slice row j)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n_dev = self._pipe2d.coal_devices
+        cap = self._device_batch_cap(1)
+        b = _bucket_size(min(len(singles), n_dev * cap), n_dev, cap)
+        coal_sh = NamedSharding(self._pipe2d.mesh, P("coal"))
+        rep_sh = NamedSharding(self._pipe2d.mesh, P())
+        pipe = BatchedTrainerPipeline(self.single_pipe.trainer, b)
+        # NOTE: bucket/pad/store/autosave/progress below mirrors _run_batch
+        # (which can't be reused directly: the data tensor varies per batch
+        # here); keep the two loops in step when changing either
+        i = 0
+        while i < len(singles):
+            group = singles[i:i + b]
+            i += len(group)
+            padded = list(group) + [group[0]] * (b - len(group))
+            ids = np.asarray([s[0] for s in padded], np.int32)
+            sliced = StackedPartners(
+                x=jax.device_put(jnp.take(self.stacked.x, ids, axis=0), rep_sh),
+                y=jax.device_put(jnp.take(self.stacked.y, ids, axis=0), rep_sh),
+                mask=jax.device_put(jnp.take(self.stacked.mask, ids, axis=0), rep_sh),
+                sizes=jax.device_put(jnp.take(self.stacked.sizes, ids, axis=0), rep_sh))
+            coal = jax.device_put(jnp.eye(b, dtype=jnp.float32), coal_sh)
+            rngs = jax.device_put(
+                jnp.stack([self._coalition_rng(s) for s in padded]), coal_sh)
+            accs, epochs = pipe.scores(coal, rngs, sliced, self.val, self.test,
+                                       self._coalition_rng(()))
+            for s, acc, ep in zip(group, accs[:len(group)], epochs[:len(group)]):
+                self._store(s, float(acc))
+                self.epochs_trained += int(ep)
+                self.samples_trained += int(ep) * int(
+                    self._epoch_samples_single[s[0]])
+            if self.autosave_path is not None:
+                self.save_cache(self.autosave_path)
+            if self.progress is not None:
+                self.progress(len(group), len(singles) - i, None)
 
     def _store(self, subset: tuple, value: float) -> None:
         self.charac_fct_values[subset] = value
@@ -304,9 +452,14 @@ class CharacteristicEngine:
         singles = [k for k in missing if len(k) == 1]
         multis = [k for k in missing if len(k) > 1]
         if singles:
-            self._run_batch(singles, self.single_pipe)
+            if self._pipe2d is not None:
+                self._run_singles_sliced(singles)
+            else:
+                self._run_batch(singles, self.single_pipe)
         if multis:
-            if self._use_slots:
+            if self._pipe2d is not None:
+                self._run_batch(multis, self._pipe2d)
+            elif self._use_slots:
                 for slot_count, group in self._slot_buckets(multis):
                     self._run_batch(group, self._slot_pipe(slot_count),
                                     slot_count=slot_count)

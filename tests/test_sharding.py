@@ -120,6 +120,41 @@ def test_es_noop_skip_is_numerically_identical():
     np.testing.assert_array_equal(fast, slow)
 
 
+def test_engine_2d_partner_sharded_matches_default(monkeypatch):
+    """MPLC_TPU_PARTNER_SHARDS=2 runs multis on a [4 coal x 2 part] mesh
+    (masked path, partner dimension split inside each coalition training,
+    psum aggregation). Global-index rng keying makes it train the same
+    trajectories — the full 4-partner v(S) table must match the default
+    engine (slot execution, 1-D coal mesh) to float tolerance."""
+    from helpers import build_scenario
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+    from mplc_tpu.contrib.shapley import powerset_order
+
+    def scenario():
+        return build_scenario(partners_count=4,
+                              amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
+                              dataset_name="titanic", epoch_count=2,
+                              gradient_updates_per_pass_count=2, seed=9)
+
+    subsets = powerset_order(4)
+    # the reference engine must be genuinely 1-D even if the ambient env
+    # pre-set the knob — otherwise this compares the 2-D path to itself
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    ref_vals = CharacteristicEngine(scenario()).evaluate(subsets)
+
+    monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "2")
+    eng = CharacteristicEngine(scenario())
+    assert eng._pipe2d is not None and eng._pipe2d.part_shards == 2
+    assert eng._pipe2d.coal_devices == 4
+    vals = eng.evaluate(subsets)
+    np.testing.assert_allclose(vals, ref_vals, atol=1e-4)
+
+    # indivisible shard counts fail fast, not silently fall back
+    monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "3")
+    with pytest.raises(ValueError, match="must divide"):
+        CharacteristicEngine(scenario())
+
+
 def test_autosave_checkpoints_every_batch(tmp_path, monkeypatch):
     """A crash mid-sweep must lose at most one device batch: with
     autosave_path set, the memo cache is persisted after EVERY batch
