@@ -42,6 +42,21 @@ def make_cluster_data(rng, n, centers):
     return x, np.eye(num_classes, dtype=np.float32)[y]
 
 
+def cluster_mlp_dataset(n=600, num_classes=4, seed=20, scale=2.5):
+    """Tiny categorical Dataset: Gaussian clusters + the 2-layer MLP."""
+    import numpy as np
+
+    from mplc_tpu.data.datasets import Dataset
+
+    mlp = cluster_mlp_model(num_classes)
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(num_classes, 16)).astype(np.float32) * scale
+    x, y = make_cluster_data(rng, n, centers)
+    xt, yt = make_cluster_data(rng, n // 3, centers)
+    return Dataset("clusters", (16,), num_classes, x, y, xt, yt,
+                   model=mlp, provenance="test")
+
+
 def build_scenario(**overrides):
     """A prepped 3-partner scenario; pass `dataset=` or `dataset_name=`
     plus any Scenario kwarg to override the quick defaults."""

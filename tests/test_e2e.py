@@ -85,19 +85,8 @@ def test_corrupted_partner_detection_oracle():
 
 def _cluster_mlp_dataset(n=600, num_classes=4, seed=20):
     """Tiny categorical problem: 4 Gaussian clusters, 2-layer MLP."""
-    from helpers import cluster_mlp_model, make_cluster_data
-
-    mlp = cluster_mlp_model(num_classes)
-    rng = np.random.default_rng(seed)
-    centers = rng.normal(size=(num_classes, 16)).astype(np.float32) * 2.5
-
-    def make(m):
-        return make_cluster_data(rng, m, centers)
-
-    x, y = make(n)
-    xt, yt = make(n // 3)
-    return Dataset("clusters", (16,), num_classes, x, y, xt, yt,
-                   model=mlp, provenance="test")
+    from helpers import cluster_mlp_dataset
+    return cluster_mlp_dataset(n, num_classes, seed)
 
 
 @pytest.mark.slow

@@ -155,6 +155,36 @@ def test_engine_2d_partner_sharded_matches_default(monkeypatch):
         CharacteristicEngine(scenario())
 
 
+def test_engine_2d_lflip_matches_default(monkeypatch):
+    """The 2-D pipeline's lflip state specs (theta [B,P,K,K] and theta_h
+    [B,E,P,K,K] sharded over coal+part) only exist under lflip — the
+    fedavg parity test never exercises them. Same equality contract."""
+    from helpers import build_scenario, cluster_mlp_dataset
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+    from mplc_tpu.contrib.shapley import powerset_order
+
+    def scenario():
+        return build_scenario(partners_count=4,
+                              amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
+                              dataset=cluster_mlp_dataset(n=700, seed=13),
+                              multi_partner_learning_approach="lflip",
+                              epoch_count=2,
+                              gradient_updates_per_pass_count=2, seed=9)
+
+    subsets = powerset_order(4)
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    ref_vals = CharacteristicEngine(scenario()).evaluate(subsets)
+    # the characteristic values must discriminate, or parity is vacuous
+    assert ref_vals.max() - ref_vals.min() > 1e-3
+
+    monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "2")
+    eng = CharacteristicEngine(scenario())
+    assert eng._pipe2d is not None
+    assert eng._pipe2d.trainer.cfg.approach == "lflip"
+    vals = eng.evaluate(subsets)
+    np.testing.assert_allclose(vals, ref_vals, atol=1e-4)
+
+
 def test_autosave_checkpoints_every_batch(tmp_path, monkeypatch):
     """A crash mid-sweep must lose at most one device batch: with
     autosave_path set, the memo cache is persisted after EVERY batch
