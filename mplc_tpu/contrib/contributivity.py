@@ -16,7 +16,9 @@ instead of training one subset at a time. Concretely:
   - the importance-sampling methods draw a block of iterations up front and
     evaluate the block's (S, S u {k}) pairs in one batch (the samples are
     i.i.d. so blocking only affects when the stopping rule is checked, not
-    the estimator);
+    the estimator); the draws themselves come from tabulated/vectorized
+    samplers (contrib/sampling.py) instead of the reference's O(2^(n-1))
+    power-set walk per draw;
   - the stratified methods stay iteration-sequential (their allocation is
     adaptive) but batch the n (S, S u {k}) pairs inside each iteration.
 
@@ -35,7 +37,7 @@ import datetime
 import logging
 import time
 from itertools import combinations
-from math import factorial
+from math import comb, factorial
 
 import numpy as np
 from scipy.stats import norm
@@ -46,6 +48,8 @@ import jax.numpy as jnp
 from .. import constants
 from ..mpl.engine import MplTrainer, TrainConfig
 from .engine import CharacteristicEngine
+from .sampling import (WithoutReplacementRanks, make_importance_sampler,
+                       randbelow, unrank_combination)
 from .shapley import powerset_order, shapley_from_characteristic
 
 logger = logging.getLogger("mplc_tpu")
@@ -55,9 +59,11 @@ class KrigingModel:
     """Gaussian-process regressor with polynomial trend, used by AIS
     (reference contributivity.py:22-61). Vectorized numpy implementation."""
 
-    def __init__(self, degre: int, covariance_func):
+    def __init__(self, degre: int, covariance_func, cov_batch=None):
         self.degre = degre
         self.cov_f = covariance_func
+        # optional vectorized covariance: (queries [B,d], train [M,d]) -> [B,M]
+        self.cov_batch = cov_batch
         self.X = self.Y = self.beta = self.H = self.invK = None
 
     def fit(self, X, Y):
@@ -83,6 +89,19 @@ class KrigingModel:
         gx = np.array([np.sum(x) ** i for i in range(self.degre + 1)])
         cx = np.array([self.cov_f(xi, x) for xi in self.X])
         return gx @ self.beta + cx @ self.invK @ (self.Y - self.H @ self.beta)
+
+    def predict_batch(self, Xq):
+        """Vectorized predict over [B, d] query rows; one matmul instead of
+        B python-level predict calls (feeds the tabulated IS sampler)."""
+        Xq = np.asarray(Xq, float)
+        s = Xq.sum(axis=1)
+        G = np.stack([s ** i for i in range(self.degre + 1)], axis=1)
+        Xtr = np.stack(self.X)
+        if self.cov_batch is not None:
+            C = self.cov_batch(Xq, Xtr)
+        else:
+            C = np.array([[self.cov_f(xt, xq) for xt in Xtr] for xq in Xq])
+        return G @ self.beta + C @ (self.invK @ (self.Y - self.H @ self.beta))
 
 
 def power_set(lst):
@@ -239,53 +258,35 @@ class Contributivity:
     # 5/6/7. importance sampling (linear / regression / adaptive Kriging)
     # ------------------------------------------------------------------
 
-    def _prob(self, size, n):
-        return factorial(n - 1 - size) * factorial(size) / factorial(n)
+    def _build_samplers(self, n, batch_fn_for):
+        """One importance sampler per partner. `batch_fn_for(k)` returns a
+        vectorized |approx increment| model over [B, n-1] membership masks
+        of N\\{k}; the sampler tabulates the reference's IS proposal from it
+        (see contrib/sampling.py — exact below MAX_EXACT_BITS partners,
+        size-stratified above)."""
+        return [make_importance_sampler(n, k, batch_fn_for(k), self._rng)
+                for k in range(n)]
 
-    def _sample_via_importance(self, k, n, approx_increment, renorm, u):
-        """Inverse-CDF draw over subsets of N\\{k}, in the reference's
-        enumeration order (size-ascending, lexicographic)."""
-        list_k = np.delete(np.arange(n), k)
-        cum = 0.0
-        last = ()
-        for length in range(len(list_k) + 1):
-            for subset in combinations(list_k, length):
-                cum += self._prob(len(subset), n) * abs(approx_increment(subset, k))
-                last = subset
-                if cum / renorm > u:
-                    return np.array(subset, int)
-        return np.array(last, int)
-
-    def _renorms(self, n, approx_increment):
-        renorms = []
-        for k in range(n):
-            list_k = np.delete(np.arange(n), k)
-            r = 0.0
-            for length in range(len(list_k) + 1):
-                for subset in combinations(list_k, length):
-                    r += self._prob(len(subset), n) * abs(approx_increment(subset, k))
-            renorms.append(r)
-        return renorms
-
-    def _is_sampling_loop(self, n, approx_increment, renorms, sv_accuracy, alpha,
+    def _is_sampling_loop(self, n, samplers, sv_accuracy, alpha,
                           t0, name, block=8, refit_every=None, refit_fn=None):
         q = -norm.ppf((1 - alpha) / 2, loc=0, scale=1)
-        contributions = np.zeros((0, n))
+        contributions = []
         t = 0
         v_max = 0.0
+        since_refit = 0
         while t < 100 or t < 4 * q ** 2 * v_max / sv_accuracy ** 2:
             if refit_every is not None and refit_fn is not None and \
-                    t // refit_every != (t + block - 1) // refit_every and t > 0:
-                approx_increment, renorms = refit_fn()
+                    since_refit >= refit_every:
+                samplers = refit_fn()
+                since_refit = 0
             rounds = []
             requests = []
             for _ in range(block):
                 row = []
                 for k in range(n):
                     u = self._rng.uniform()
-                    S = self._sample_via_importance(k, n, approx_increment,
-                                                    renorms[k], u)
-                    row.append(S)
+                    S, weight = samplers[k].draw(u, self._rng)
+                    row.append((S, weight))
                     requests.append(tuple(sorted(S.tolist() + [k])))
                     requests.append(tuple(sorted(S.tolist())))
                 rounds.append(row)
@@ -293,14 +294,16 @@ class Contributivity:
             vals = self.engine.charac_fct_values
             for row in rounds:
                 contrib_row = np.zeros(n)
-                for k, S in enumerate(row):
+                for k, (S, weight) in enumerate(row):
                     s_key = tuple(sorted(int(x) for x in S))
                     sk_key = tuple(sorted(list(s_key) + [k]))
                     increment = vals[sk_key] - vals.get(s_key, 0.0)
-                    contrib_row[k] = increment * renorms[k] / abs(approx_increment(S, k))
-                contributions = np.vstack([contributions, contrib_row])
+                    contrib_row[k] = increment * weight
+                contributions.append(contrib_row)
             t += block
-            v_max = np.max(np.var(contributions, axis=0))
+            since_refit += block
+            v_max = np.max(np.var(np.asarray(contributions), axis=0))
+        contributions = np.asarray(contributions)
         sv = np.mean(contributions, axis=0)
         std = np.std(contributions, axis=0) / np.sqrt(t - 1)
         self._finish(name, sv, std, t0)
@@ -323,12 +326,16 @@ class Contributivity:
         sizes = self._sizes()
         size_of_i = sizes.sum()
 
-        def approx_increment(subset, k):
-            beta = sizes[np.asarray(subset, int)].sum() / size_of_i if len(subset) else 0.0
-            return (1 - beta) * first_inc[k] + beta * last_inc[k]
+        def batch_fn_for(k):
+            sizes_k = sizes[np.delete(np.arange(n), k)]
 
-        renorms = self._renorms(n, approx_increment)
-        self._is_sampling_loop(n, approx_increment, renorms, sv_accuracy, alpha,
+            def batch(masks):
+                beta = (masks @ sizes_k) / size_of_i
+                return (1 - beta) * first_inc[k] + beta * last_inc[k]
+            return batch
+
+        samplers = self._build_samplers(n, batch_fn_for)
+        self._is_sampling_loop(n, samplers, sv_accuracy, alpha,
                                t0, "IS_lin Shapley")
 
     def IS_reg(self, sv_accuracy=0.01, alpha=0.95):
@@ -367,11 +374,17 @@ class Contributivity:
             model_k.fit(np.array(x), np.array(y))
             models.append(model_k)
 
-        def approx_increment(subset, k):
-            return float(models[k].predict(makedata(subset).reshape(1, -1))[0])
+        def batch_fn_for(k):
+            sizes_k = sizes[np.delete(np.arange(n), k)]
+            model_k = models[k]
 
-        renorms = self._renorms(n, approx_increment)
-        self._is_sampling_loop(n, approx_increment, renorms, sv_accuracy, alpha,
+            def batch(masks):
+                w = masks @ sizes_k
+                return model_k.predict(np.stack([w, w * w], axis=1))
+            return batch
+
+        samplers = self._build_samplers(n, batch_fn_for)
+        self._is_sampling_loop(n, samplers, sv_accuracy, alpha,
                                t0, "IS_reg Shapley")
 
     def AIS_Kriging(self, sv_accuracy=0.01, alpha=0.95, update=50):
@@ -407,22 +420,40 @@ class Contributivity:
         def make_cov(k):
             return lambda x1, x2: np.exp(-dist(x1, x2) ** 2 / max(phi[k] ** 2, 1e-12))
 
+        def make_cov_batch(k):
+            denom = max(phi[k] ** 2, 1e-12)
+
+            def cb(A, B):
+                # ||a-b||^2 via the inner-product identity: holds only the
+                # [B, M] result, never a [B, M, d] broadcast intermediate
+                # (the table B can be 2^16 rows)
+                d2 = ((A * A).sum(1)[:, None] + (B * B).sum(1)[None, :]
+                      - 2.0 * (A @ B.T))
+                return np.exp(-np.maximum(d2, 0.0) / denom)
+            return cb
+
         def refit():
             models = []
             for k in range(n):
                 x = [make_coordinate(subset, k)
                      for subset in self.engine.increments_values[k]]
                 y = list(self.engine.increments_values[k].values())
-                m = KrigingModel(2, make_cov(k))
+                m = KrigingModel(2, make_cov(k), cov_batch=make_cov_batch(k))
                 m.fit(x, y)
                 models.append(m)
 
-            def approx_increment(subset, k):
-                return float(models[k].predict(make_coordinate(subset, k)))
-            return approx_increment, self._renorms(n, approx_increment)
+            def batch_fn_for(k):
+                sizes_k = sizes[np.delete(np.arange(n), k)]
+                model_k = models[k]
 
-        approx_increment, renorms = refit()
-        self._is_sampling_loop(n, approx_increment, renorms, sv_accuracy, alpha,
+                def batch(masks):
+                    return model_k.predict_batch(masks * sizes_k)
+                return batch
+
+            return self._build_samplers(n, batch_fn_for)
+
+        samplers = refit()
+        self._is_sampling_loop(n, samplers, sv_accuracy, alpha,
                                t0, "AIS Shapley", block=min(8, update),
                                refit_every=update, refit_fn=refit)
 
@@ -458,17 +489,21 @@ class Contributivity:
                 else:
                     p = np.repeat(1 / N, N) * (1 - e) + sigma2[k] / np.sum(sigma2[k]) * e
                 strata = self._rng.choice(np.arange(N), 1, p=p)[0]
-                # uniform draw of a size-`strata` subset of N\{k}
+                # uniform draw of a size-`strata` subset of N\{k}: the
+                # reference walks the C(N-1, strata) combinations summing a
+                # constant probability per step (contributivity.py:757-768);
+                # the walk's stopping index is just floor(u * C) — unrank it
+                # directly instead of enumerating.
                 u = self._rng.uniform()
-                cum = 0.0
                 list_k = np.delete(np.arange(N), k)
-                S = np.array(list(combinations(list_k, strata))[-1] if strata else (), int)
-                for subset in combinations(list_k, strata):
-                    cum += (factorial(N - 1 - strata) * factorial(strata)
-                            / factorial(N - 1))
-                    if cum > u:
-                        S = np.array(subset, int)
-                        break
+                total = comb(N - 1, int(strata))
+                if total <= 2 ** 53:
+                    idx = min(int(u * total), total - 1)
+                else:
+                    # float inverse-CDF can't index strata larger than 2^53
+                    idx = randbelow(self._rng, total)
+                S = np.array(list_k[unrank_combination(N - 1, int(strata), idx)],
+                             int)
                 plan.append((k, strata, S))
             # batch this iteration's 2N evaluations
             reqs = []
@@ -514,12 +549,12 @@ class Contributivity:
         v_max = 0.0
         continuer = [[True] * N for _ in range(N)]
         inc_generated = [[dict() for _ in range(N)] for _ in range(N)]
-        inc_to_generate = [[list() for _ in range(N)] for _ in range(N)]
-        for k in range(N):
-            list_k = np.delete(np.arange(N), k)
-            for strata in range(N):
-                inc_to_generate[k][strata] = [tuple(s) for s in
-                                              combinations(list_k, strata)]
+        # without-replacement pools over combination *ranks* (sparse
+        # Fisher-Yates) — the reference materializes every subset of every
+        # stratum up front (contributivity.py:838-843), which is exponential
+        # memory; ranks are unranked lazily at draw time instead.
+        pools = [[WithoutReplacementRanks(comb(N - 1, strata))
+                  for strata in range(N)] for _ in range(N)]
         while np.any(continuer) or (1 - alpha) < v_max / sv_accuracy ** 2:
             t += 1
             plan = []
@@ -531,11 +566,13 @@ class Contributivity:
                 else:
                     p = sigma2[k] / np.sum(sigma2[k])
                 strata = self._rng.choice(np.arange(N), 1, p=p)[0]
-                if not inc_to_generate[k][strata]:
+                if not len(pools[k][strata]):
                     continuer[k][strata] = False
                     continue
-                pick = self._rng.integers(len(inc_to_generate[k][strata]))
-                subset = inc_to_generate[k][strata].pop(pick)
+                rank = pools[k][strata].pop_random(self._rng)
+                list_k = np.delete(np.arange(N), k)
+                subset = tuple(int(i) for i in
+                               list_k[unrank_combination(N - 1, int(strata), rank)])
                 plan.append((k, strata, np.array(subset, int)))
             if plan:
                 reqs = []
@@ -699,10 +736,16 @@ class Contributivity:
             loss = float(ev(state.params, eng.val)[0])
             G = -loss + prev_loss
             dp_dw = np.exp(w) / (1 + np.exp(w)) ** 2
-            prodp = np.prod(values)
-            grad = (is_in / values - (1.0 - is_in) / (1.0 - values)
-                    - prodp / (1.0 - prodp) / (1.0 - values))
-            w = w + learning_rate * G * dp_dw * grad
+            # The REINFORCE gradient has 1/(1-p) and prodp/(1-prodp) poles:
+            # the reference divides by zero once any selection prob
+            # saturates (contributivity.py:942-1013 intent). Clamp the probs
+            # used in the gradient and bound the logits so the update can
+            # never produce inf/NaN.
+            safe = np.clip(values, 1e-6, 1.0 - 1e-6)
+            prodp = np.prod(safe)
+            grad = (is_in / safe - (1.0 - is_in) / (1.0 - safe)
+                    - prodp / (1.0 - prodp) / (1.0 - safe))
+            w = np.clip(w + learning_rate * G * dp_dw * grad, -10.0, 10.0)
             values = 1.0 / (1.0 + np.exp(-w))
             prev_loss = loss
         self._finish("PVRL", values, np.zeros(n), t0)

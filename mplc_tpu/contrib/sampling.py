@@ -1,0 +1,206 @@
+"""Subset samplers for the importance-sampling Shapley estimators.
+
+The reference draws each importance sample by walking the full power set of
+N\\{k} with a Python loop — O(2^(n-1)) *per draw, per partner, per
+iteration* (/root/reference/mplc/contributivity.py:326-439, the
+`characteristic_no_nul_proba` / inverse-CDF walk). Here the same
+distributions are produced from precomputed, vectorized tables:
+
+  * `ExactSubsetSampler` — enumerates the subsets of N\\{k} once per refit
+    (the reference's size-ascending, lexicographic order), evaluates the
+    |approximate increment| for the whole table in ONE vectorized call, and
+    turns each draw into a binary search over the cumulative distribution.
+    Identical draw distribution and identical importance weights to the
+    reference's walk, at O(2^m) vectorized work per *refit* instead of
+    O(2^m) interpreted work per *draw*.
+
+  * `SizeStratifiedSubsetSampler` — for partner counts where enumeration is
+    infeasible (m = n-1 > max_exact_bits), an exact-weight two-stage
+    proposal: draw the coalition size l from p_l ∝ P_shapley(l)·C(m,l)·g(l)
+    (g = probed mean |increment| per size, mixed with a uniform floor so
+    every size keeps positive mass), then a uniform size-l subset. Because
+    P_shapley(l)·C(m,l) = 1/n exactly, the importance weight
+    P(S)/q(S) = 1/(n·p_l) is closed-form and the estimator stays unbiased
+    for ANY probe quality — g only shapes variance, never bias.
+
+Both expose `draw(u, rng) -> (subset ndarray, weight)` where `weight` is the
+multiplier for the observed increment in the Shapley estimator (the
+reference's `renorm / |approx_increment(S)|`).
+
+Also here: lexicographic combination unranking (used to turn the stratified
+MC methods' uniform-subset draws from enumeration walks into O(l·m)
+arithmetic) and a sparse without-replacement rank pool (so WR_SMC no longer
+materializes all C(m,l) subsets up front —
+/root/reference/mplc/contributivity.py:823-938 builds the full list per
+stratum).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from itertools import combinations
+from math import comb, factorial
+
+import numpy as np
+
+# Above this many non-k partners the IS samplers switch from exact power-set
+# tables (2^m rows) to the two-stage size-stratified proposal.
+MAX_EXACT_BITS = 16
+
+
+def shapley_size_prob(size: int, n: int) -> float:
+    """P_shapley(S) for one |S|=size subset of N\\{k}: |S|!(n-1-|S|)!/n!."""
+    return factorial(n - 1 - size) * factorial(size) / factorial(n)
+
+
+@lru_cache(maxsize=4)
+def combination_mask_table(m: int) -> tuple[np.ndarray, np.ndarray]:
+    """All subsets of range(m) as a [2^m, m] bool matrix, in the reference's
+    enumeration order (size-ascending, lexicographic within a size).
+    Returns (masks, sizes-per-row). Cached: every per-partner sampler (and
+    every AIS refit) shares one table — callers must treat it as
+    read-only."""
+    blocks = []
+    sizes = []
+    for length in range(m + 1):
+        if length == 0:
+            blocks.append(np.zeros((1, m), bool))
+            sizes.append(np.zeros(1, int))
+            continue
+        idx = np.array(list(combinations(range(m), length)), int)
+        rows = np.zeros((len(idx), m), bool)
+        rows[np.arange(len(idx))[:, None], idx] = True
+        blocks.append(rows)
+        sizes.append(np.full(len(idx), length, int))
+    return np.concatenate(blocks), np.concatenate(sizes)
+
+
+def unrank_combination(m: int, length: int, rank: int) -> list[int]:
+    """rank-th (0-based) size-`length` combination of range(m) in
+    lexicographic order, without enumerating its predecessors."""
+    out = []
+    x = 0
+    for i in range(length):
+        while True:
+            c = comb(m - x - 1, length - i - 1)
+            if rank < c:
+                out.append(x)
+                x += 1
+                break
+            rank -= c
+            x += 1
+    return out
+
+
+def randbelow(rng: np.random.Generator, n: int) -> int:
+    """Uniform integer in [0, n) for arbitrarily large Python ints (numpy's
+    integers() caps at int64; WR_SMC stratum cardinalities can exceed it)."""
+    if n <= 0:
+        raise ValueError("randbelow needs n >= 1")
+    bits = n.bit_length()
+    nbytes = (bits + 7) // 8
+    while True:
+        r = int.from_bytes(rng.bytes(nbytes), "little") >> (nbytes * 8 - bits)
+        if r < n:
+            return r
+
+
+class WithoutReplacementRanks:
+    """Sparse Fisher-Yates over ranks [0, total): pop a uniformly random
+    not-yet-seen rank in O(1) time and O(draws) memory."""
+
+    def __init__(self, total: int):
+        self.total = total
+        self._moved: dict[int, int] = {}
+
+    def __len__(self):
+        return self.total
+
+    def pop_random(self, rng: np.random.Generator) -> int:
+        if self.total <= 0:
+            raise IndexError("pool exhausted")
+        j = randbelow(rng, self.total)
+        val = self._moved.get(j, j)
+        last = self.total - 1
+        self._moved[j] = self._moved.pop(last, last)
+        if j == last:
+            self._moved.pop(j, None)
+        self.total = last
+        return val
+
+
+class ExactSubsetSampler:
+    """Inverse-CDF sampler over all subsets of N\\{k}, weighted by
+    P_shapley(|S|)·|approx_increment(S, k)| — the reference's IS proposal,
+    tabulated once. `batch_fn(masks) -> [B] increments` is evaluated
+    vectorized over the whole table at construction."""
+
+    def __init__(self, n: int, k: int, batch_fn):
+        self.n = n
+        self.k = k
+        self.members = np.delete(np.arange(n), k)
+        m = n - 1
+        self.masks, sizes = combination_mask_table(m)
+        probs = np.array([shapley_size_prob(int(s), n) for s in range(m + 1)])
+        self.f = np.abs(np.asarray(batch_fn(self.masks), float))
+        w = probs[sizes] * self.f
+        self.renorm = float(w.sum())
+        if self.renorm <= 0:
+            # degenerate model (all-zero increments): fall back to the
+            # plain Shapley size distribution, weights handled below
+            w = probs[sizes]
+            self.renorm = float(w.sum())
+            self.f = np.ones_like(self.f)
+        self._cdf = np.cumsum(w) / self.renorm
+
+    def draw(self, u: float, rng=None):
+        idx = int(np.searchsorted(self._cdf, u, side="right"))
+        idx = min(idx, len(self._cdf) - 1)
+        subset = self.members[self.masks[idx]]
+        weight = self.renorm / max(self.f[idx], 1e-300)
+        return subset, weight
+
+
+class SizeStratifiedSubsetSampler:
+    """Two-stage exact-weight proposal for large n (see module docstring)."""
+
+    def __init__(self, n: int, k: int, batch_fn, rng: np.random.Generator,
+                 probes_per_size: int = 8, uniform_mix: float = 0.05):
+        self.n = n
+        self.k = k
+        self.members = np.delete(np.arange(n), k)
+        m = n - 1
+        g = np.zeros(m + 1)
+        for length in range(m + 1):
+            rows = np.zeros((probes_per_size, m), bool)
+            for r in range(probes_per_size):
+                if length:
+                    rows[r, rng.choice(m, length, replace=False)] = True
+            g[length] = float(np.mean(np.abs(np.asarray(
+                batch_fn(rows), float))))
+        total = g.sum()
+        if total <= 0:
+            g = np.ones(m + 1)
+            total = g.sum()
+        p = (1 - uniform_mix) * g / total + uniform_mix / (m + 1)
+        self._p = p
+        self._cdf = np.cumsum(p)
+        # P_shapley(l)·C(m,l) = l!(n-1-l)!/n! · (n-1)!/(l!(n-1-l)!) = 1/n
+        self._weight_per_size = 1.0 / (n * p)
+
+    def draw(self, u: float, rng: np.random.Generator):
+        length = int(np.searchsorted(self._cdf, u, side="right"))
+        length = min(length, len(self._cdf) - 1)
+        if length:
+            subset = np.sort(rng.choice(self.members, length, replace=False))
+        else:
+            subset = np.array([], int)
+        return subset, float(self._weight_per_size[length])
+
+
+def make_importance_sampler(n: int, k: int, batch_fn,
+                            rng: np.random.Generator,
+                            max_exact_bits: int = MAX_EXACT_BITS):
+    if n - 1 <= max_exact_bits:
+        return ExactSubsetSampler(n, k, batch_fn)
+    return SizeStratifiedSubsetSampler(n, k, batch_fn, rng)

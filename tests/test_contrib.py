@@ -170,6 +170,22 @@ def test_is_lin_additive():
     assert np.allclose(c.contributivity_scores, [0.1, 0.2, 0.3, 0.4], atol=1e-6)
 
 
+def test_is_lin_additive_stratified_mode(monkeypatch):
+    """Force the large-n two-stage sampler (contrib/sampling.py) through the
+    IS_lin estimator: the exact-weight proposal must still recover the
+    additive game's Shapley values."""
+    import mplc_tpu.contrib.contributivity as contrib_mod
+    from mplc_tpu.contrib import sampling
+    orig = sampling.make_importance_sampler
+    monkeypatch.setattr(
+        contrib_mod, "make_importance_sampler",
+        lambda n, k, fn, rng: orig(n, k, fn, rng, max_exact_bits=2))
+    sc = fake_scenario(5, additive(PHI5))
+    c = Contributivity(sc)
+    c.IS_lin(sv_accuracy=0.05, alpha=0.95)
+    assert np.allclose(c.contributivity_scores, PHI5, atol=0.02)
+
+
 def test_is_reg_additive():
     phi = [0.1, 0.2, 0.3, 0.15, 0.25]
     sc = fake_scenario(5, additive(phi))
@@ -192,6 +208,29 @@ def test_ais_kriging_additive():
     c = Contributivity(sc)
     c.AIS_Kriging(sv_accuracy=0.05, alpha=0.95, update=50)
     assert np.allclose(c.contributivity_scores, phi, atol=0.05)
+
+
+def test_is_loop_refits_when_update_not_larger_than_block():
+    """Adaptive refit must fire even when refit_every <= block (the old
+    block-boundary-crossing condition was identically false there)."""
+    import time
+    sc = fake_scenario(4, additive([0.1, 0.2, 0.3, 0.4]))
+    c = Contributivity(sc)
+    n = 4
+
+    def batch_fn_for(k):
+        return lambda masks: np.ones(masks.shape[0])
+
+    count = {"refits": 0}
+
+    def refit():
+        count["refits"] += 1
+        return c._build_samplers(n, batch_fn_for)
+
+    c._is_sampling_loop(n, c._build_samplers(n, batch_fn_for), 0.05, 0.95,
+                        time.perf_counter(), "refit-probe", block=8,
+                        refit_every=8, refit_fn=refit)
+    assert count["refits"] >= 2
 
 
 def test_smcs_additive():
