@@ -315,7 +315,10 @@ def _fwd_flops_per_sample(engine):
 
 
 def _peak_flops_per_chip():
-    """bf16 peak of the attached chip (public spec sheets); None = unknown."""
+    """bf16 peak of the attached chip; None = unknown kind.
+
+    Sources: Google Cloud TPU public spec pages — v4 275 TFLOP/s bf16,
+    v5e 197, v5p 459, v6e (Trillium) 918."""
     import jax
     kind = jax.devices()[0].device_kind.lower()
     table = {"tpu v5 lite": 197e12, "tpu v5e": 197e12, "tpu v5p": 459e12,
@@ -323,6 +326,8 @@ def _peak_flops_per_chip():
     for k, v in table.items():
         if k in kind:
             return v
+    print(f"[bench] unknown device_kind {kind!r}: no bf16-peak entry, "
+          f"MFU line suppressed", file=sys.stderr)
     return None
 
 
@@ -382,7 +387,11 @@ def bench_exact_shapley(epochs, dtype):
 
     timed = _attach_progress(_fresh_engine(sc, warm), "timed")
     t0 = time.perf_counter()
-    accs = timed.evaluate(coalitions)
+    # a real device trace of the timed sweep when MPLC_TPU_PROFILE_DIR is
+    # set (utils.profile_trace is a no-op otherwise)
+    from mplc_tpu.utils import profile_trace
+    with profile_trace():
+        accs = timed.evaluate(coalitions)
     elapsed = time.perf_counter() - t0
     assert timed.first_charac_fct_calls_count == B
 
@@ -413,11 +422,27 @@ def _bench_method(dataset_name, n_partners, method, epochs, dtype,
     print("[bench] compiled; timing...", file=sys.stderr)
 
     timed = _attach_progress(_fresh_engine(sc, warm), "timed")
+    # split wall-clock into engine-evaluate time vs host-side estimator
+    # time (sampling, refits, stopping rule) — the estimator loops must
+    # stay <10% of wall-clock now that the IS/SMC draws are tabulated
+    engine_time = {"s": 0.0}
+    orig_eval = timed.evaluate
+
+    def _timed_eval(subsets):
+        te = time.perf_counter()
+        try:
+            return orig_eval(subsets)
+        finally:
+            engine_time["s"] += time.perf_counter() - te
+
+    timed.evaluate = _timed_eval
+    from mplc_tpu.utils import profile_trace
     t0 = time.perf_counter()
-    contrib = Contributivity(sc)
-    contrib.compute_contributivity(method)
-    for m in extra_methods:
-        Contributivity(sc).compute_contributivity(m)
+    with profile_trace():
+        contrib = Contributivity(sc)
+        contrib.compute_contributivity(method)
+        for m in extra_methods:
+            Contributivity(sc).compute_contributivity(m)
     elapsed = time.perf_counter() - t0
     calls = timed.first_charac_fct_calls_count
 
@@ -427,6 +452,10 @@ def _bench_method(dataset_name, n_partners, method, epochs, dtype,
     print(f"[bench] {elapsed:.1f} s for {calls} distinct coalition trainings "
           f"({elapsed / max(calls, 1):.3f} s each) on {_ndev()} device(s)",
           file=sys.stderr)
+    host = elapsed - engine_time["s"]
+    print(f"[bench] engine.evaluate {engine_time['s']:.1f} s, host-side "
+          f"estimator {host:.1f} s ({100 * host / max(elapsed, 1e-9):.1f}% "
+          f"of wall-clock)", file=sys.stderr)
     _throughput_note(timed, elapsed)
     tag = method.lower().replace(" ", "_")
     _emit(f"{tag}_{dataset_name}_{n_partners}partners_{epochs}epochs_wallclock",

@@ -566,7 +566,7 @@ class Contributivity:
                 else:
                     p = sigma2[k] / np.sum(sigma2[k])
                 strata = self._rng.choice(np.arange(N), 1, p=p)[0]
-                if not len(pools[k][strata]):
+                if pools[k][strata].total <= 0:  # __len__ caps at sys.maxsize
                     continuer[k][strata] = False
                     continue
                 rank = pools[k][strata].pop_random(self._rng)

@@ -221,7 +221,16 @@ class CharacteristicEngine:
         # masked path (global-index rng keying).
         self._pipe2d = None
         _env = os.environ.get("MPLC_TPU_PARTNER_SHARDS")
-        part_shards = int(_env) if _env else 1
+        if _env:
+            part_shards = int(_env)  # env var wins over the Scenario param
+            if part_shards < 1:
+                raise ValueError(
+                    f"MPLC_TPU_PARTNER_SHARDS must be >= 1, got {_env!r}")
+        else:
+            part_shards = int(getattr(scenario, "partner_shards", None) or 1)
+        # write the effective value back so to_dataframe/results.csv record
+        # the mode actually run, even under the env override
+        scenario.partner_shards = part_shards
         if part_shards > 1:
             n_dev = len(jax.devices())
             if multi_cfg.approach not in ("fedavg", "lflip"):

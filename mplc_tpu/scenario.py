@@ -58,6 +58,7 @@ _PARAMS_KNOWN = [
     "is_early_stopping",
     "init_model_from", "is_quick_demo",
     "seed", "compute_dtype", "contributivity_cache_from",
+    "partner_shards",
 ]
 
 
@@ -72,7 +73,7 @@ class Scenario:
                  corrupted_datasets=None,
                  init_model_from="random_initialization",
                  multi_partner_learning_approach="fedavg",
-                 aggregation_weighting="data-volume",
+                 aggregation_weighting=None,
                  gradient_updates_per_pass_count=constants.DEFAULT_GRADIENT_UPDATES_PER_PASS_COUNT,
                  minibatch_count=constants.DEFAULT_BATCH_COUNT,
                  epoch_count=constants.DEFAULT_EPOCH_COUNT,
@@ -86,11 +87,30 @@ class Scenario:
                  seed=42,
                  compute_dtype="float32",
                  contributivity_cache_from=None,
+                 partner_shards=None,
                  **kwargs):
         unrecognised = [k for k in kwargs if k not in _PARAMS_KNOWN]
         if unrecognised:
             raise Exception(
                 f"Unrecognised parameters {unrecognised}, check your configuration")
+
+        # `aggregation` is an accepted alias for `aggregation_weighting`.
+        # The reference whitelists `aggregation` but never reads it
+        # (scenario.py kwargs list), so a config written with it silently
+        # ran with the default weighting — here it takes effect, and a
+        # conflicting pair is an error instead of a silent pick.
+        aggregation_alias = kwargs.get("aggregation")
+        if aggregation_alias is not None:
+            if aggregation_weighting is not None and \
+                    _AGGREGATION_ALIASES.get(aggregation_weighting) != \
+                    _AGGREGATION_ALIASES.get(aggregation_alias):
+                raise ValueError(
+                    f"Conflicting aggregation settings: aggregation="
+                    f"{aggregation_alias!r} vs aggregation_weighting="
+                    f"{aggregation_weighting!r}; set only one")
+            aggregation_weighting = aggregation_alias
+        if aggregation_weighting is None:
+            aggregation_weighting = "data-volume"
 
         # -- dataset ----------------------------------------------------
         if isinstance(dataset, dataset_module.Dataset):
@@ -162,6 +182,12 @@ class Scenario:
         # resumable Shapley sweeps: path to a coalition cache saved by a
         # previous run of the same scenario shape (SURVEY.md §5 rebuild note)
         self.contributivity_cache_from = contributivity_cache_from
+        # 2-D [coal x part] engine mode: shard the partner dimension over
+        # this many devices inside each coalition training (1/None = 1-D
+        # coalition-only sharding). MPLC_TPU_PARTNER_SHARDS overrides.
+        self.partner_shards = 1 if partner_shards is None else int(partner_shards)
+        if self.partner_shards < 1:
+            raise ValueError(f"partner_shards must be >= 1, got {partner_shards}")
 
         # -- contributivity methods -------------------------------------
         self.contributivity_list: list[Contributivity] = []
@@ -350,6 +376,7 @@ class Scenario:
             "final_relative_nb_samples": str(self.final_relative_nb_samples),
             "multi_partner_learning_approach": self.multi_partner_learning_approach_key,
             "aggregation": self.aggregation_name,
+            "partner_shards": self.partner_shards,
             "epoch_count": self.epoch_count,
             "minibatch_count": self.minibatch_count,
             "gradient_updates_per_pass_count": self.gradient_updates_per_pass_count,

@@ -163,13 +163,43 @@ def parse_grid_shard(spec):
     return i, n
 
 
+class ConsoleLevelFilter(logging.Filter):
+    """Runtime-switchable console verbosity. The reference's loguru
+    `MyFilter` lets the console level change after the sink is installed
+    (/root/reference/mplc/utils.py:165-193); stdlib handlers freeze their
+    level at setLevel time, so the handler stays at DEBUG and this filter
+    decides — flip it any time via `set_console_level`."""
+
+    def __init__(self, level=logging.INFO):
+        super().__init__()
+        self.level = level
+
+    def filter(self, record):
+        return record.levelno >= self.level
+
+
+_console_filter = ConsoleLevelFilter()
+
+
+def set_console_level(level):
+    """Change the console verbosity at runtime ('DEBUG'/'INFO'/... or a
+    logging int constant)."""
+    if isinstance(level, str):
+        level = logging.getLevelName(level.upper())
+        if not isinstance(level, int):  # getLevelName echoes unknown names
+            raise ValueError(f"unknown log level {level!r}")
+    _console_filter.level = level
+
+
 def init_logger(debug=False):
     root = logging.getLogger("mplc_tpu")
     root.setLevel(logging.DEBUG)
     for h in list(root.handlers):
         root.removeHandler(h)
     console = logging.StreamHandler(sys.stdout)
-    console.setLevel(logging.DEBUG if debug else logging.INFO)
+    console.setLevel(logging.DEBUG)  # the filter decides, not the handler
+    _console_filter.level = logging.DEBUG if debug else logging.INFO
+    console.addFilter(_console_filter)
     console.setFormatter(logging.Formatter(
         "%(asctime)s | %(levelname)s | %(message)s"))
     root.addHandler(console)

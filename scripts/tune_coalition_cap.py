@@ -34,6 +34,13 @@ def main():
     ap.add_argument("--dtype", default="bfloat16")
     args = ap.parse_args()
 
+    # the sweep must measure the default engine path: ambient engine-mode
+    # knobs would silently change what is being timed (the sharding tests
+    # delenv these for the same reason)
+    for knob in ("MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_NO_SLOTS"):
+        if os.environ.pop(knob, None) is not None:
+            print(f"[tune] ignoring ambient {knob}", file=sys.stderr)
+
     os.environ.setdefault("MPLC_TPU_SYNTH_NOISE", "0.75")
     import jax
     if os.environ.get("JAX_PLATFORMS"):

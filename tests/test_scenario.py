@@ -37,6 +37,46 @@ def test_aggregation_alias_spellings(tiny_image_dataset):
         Scenario(**_tiny_kwargs(tiny_image_dataset), aggregation_weighting="bogus")
 
 
+def test_aggregation_kwarg_takes_effect(tiny_image_dataset):
+    """`aggregation:` in a config must drive the weighting (the reference
+    whitelists it but silently ignores it — SURVEY §7 quirk, fixed here)."""
+    sc = Scenario(**_tiny_kwargs(tiny_image_dataset), aggregation="local-score")
+    assert sc.aggregation_name == "local-score"
+    # matching pair (after spelling normalization) is fine
+    sc2 = Scenario(**_tiny_kwargs(tiny_image_dataset),
+                   aggregation="data_volume", aggregation_weighting="data-volume")
+    assert sc2.aggregation_name == "data-volume"
+    with pytest.raises(ValueError, match="Conflicting aggregation"):
+        Scenario(**_tiny_kwargs(tiny_image_dataset),
+                 aggregation="uniform", aggregation_weighting="local-score")
+
+
+def test_partner_shards_param_recorded(tiny_image_dataset):
+    sc = Scenario(**_tiny_kwargs(tiny_image_dataset), partner_shards=3)
+    assert sc.partner_shards == 3
+    df = sc.to_dataframe()
+    assert set(df["partner_shards"]) == {3}
+    assert Scenario(**_tiny_kwargs(tiny_image_dataset)).partner_shards == 1
+    with pytest.raises(ValueError, match="partner_shards"):
+        Scenario(**_tiny_kwargs(tiny_image_dataset), partner_shards=-2)
+
+
+def test_console_level_switchable_at_runtime(capsys):
+    import logging
+    from mplc_tpu import utils
+    utils.init_logger(debug=False)
+    logger = logging.getLogger("mplc_tpu")
+    logger.debug("hidden-dbg")
+    utils.set_console_level("DEBUG")
+    logger.debug("shown-dbg")
+    utils.set_console_level(logging.INFO)
+    logger.debug("hidden-again")
+    out = capsys.readouterr().out
+    assert "shown-dbg" in out
+    assert "hidden-dbg" not in out
+    assert "hidden-again" not in out
+
+
 def test_unknown_method_raises(tiny_image_dataset):
     with pytest.raises(Exception, match="not in methods list"):
         Scenario(**_tiny_kwargs(tiny_image_dataset), methods=["Not a method"])

@@ -155,6 +155,32 @@ def test_engine_2d_partner_sharded_matches_default(monkeypatch):
         CharacteristicEngine(scenario())
 
 
+def test_engine_2d_mode_via_scenario_param(monkeypatch):
+    """`partner_shards` as a Scenario/YAML parameter (no env var) selects
+    the 2-D engine mode; the env var still overrides, and the effective
+    value is written back so results.csv records the mode actually run."""
+    from helpers import build_scenario
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+
+    def scenario(**kw):
+        return build_scenario(partners_count=4,
+                              amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
+                              dataset_name="titanic", epoch_count=2,
+                              gradient_updates_per_pass_count=2, seed=9, **kw)
+
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    sc = scenario(partner_shards=2)
+    eng = CharacteristicEngine(sc)
+    assert eng._pipe2d is not None and eng._pipe2d.part_shards == 2
+    assert sc.partner_shards == 2
+
+    monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "1")
+    sc2 = scenario(partner_shards=2)
+    eng2 = CharacteristicEngine(sc2)
+    assert eng2._pipe2d is None
+    assert sc2.partner_shards == 1  # effective mode, not the ignored param
+
+
 def test_engine_2d_lflip_matches_default(monkeypatch):
     """The 2-D pipeline's lflip state specs (theta [B,P,K,K] and theta_h
     [B,E,P,K,K] sharded over coal+part) only exist under lflip — the
