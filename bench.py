@@ -163,7 +163,7 @@ def _spawn_cpu_fallback() -> int:
     # watchdog, which is deliberately off on CPU.
     for knob in ("BENCH_DTYPE", "MPLC_TPU_COALITIONS_PER_DEVICE",
                  "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
-                 "MPLC_TPU_SYNTH_SCALE",
+                 "MPLC_TPU_SLOT_POW2", "MPLC_TPU_SYNTH_SCALE",
                  "BENCH_STALL_TIMEOUT", "BENCH_INIT_TIMEOUT"):
         env.pop(knob, None)
     env.update(

@@ -212,6 +212,7 @@ class CharacteristicEngine:
         # sweep. One pipeline per coalition size, built lazily.
         self._use_slots = (multi_cfg.approach == "fedavg"
                            and os.environ.get("MPLC_TPU_NO_SLOTS") != "1")
+        self._slot_pow2 = os.environ.get("MPLC_TPU_SLOT_POW2") == "1"
         self._slot_pipes: dict[int, BatchedTrainerPipeline] = {}
 
         # 2-D [coal, part] mode (MPLC_TPU_PARTNER_SHARDS=p): shard the
@@ -247,6 +248,12 @@ class CharacteristicEngine:
             self._pipe2d = Batched2DTrainerPipeline(
                 MplTrainer.get(self.model, cfg2d), self.partners_count, mesh)
             self._use_slots = False
+        # record the slot-bucketing mode actually run in results.csv (same
+        # rationale as the partner_shards write-back above) — after the 2-D
+        # branch, which disables slot execution entirely
+        scenario.slot_bucketing = (
+            "pow2" if (self._use_slots and self._slot_pow2)
+            else "exact" if self._use_slots else "masked")
 
         self.charac_fct_values: dict[tuple, float] = {(): 0.0}
         self.increments_values = [dict() for _ in range(self.partners_count)]
@@ -477,14 +484,29 @@ class CharacteristicEngine:
         return np.array([self.charac_fct_values[k] for k in keys])
 
     def _slot_buckets(self, multis: list[tuple]) -> list[tuple[int, list[tuple]]]:
-        """Group coalitions by size: a size-k group trains k slots per
-        coalition. Tight per-size groups measure fastest on chip — merging
-        sizes into padded buckets was tried and lost, because padded slots
-        cost real compute."""
-        by_size: dict[int, list[tuple]] = {}
+        """Group coalitions by slot width.
+
+        Default: one tight group per coalition size — a size-k group trains
+        exactly k slots, no padded compute (fastest steady-state on chip).
+        With MPLC_TPU_SLOT_POW2=1, sizes round UP to the next power of two
+        (capped at the partner count), so a 10-partner sweep compiles ~4
+        slot pipelines (k in {2,4,8,10}) instead of 9: trades padded-slot
+        compute (inactive slots still run their pass) for roughly half the
+        cold-compile time. The trainer's -1 = unused-slot convention makes
+        mixed sizes inside one bucket exact, not approximate (active mask
+        zeroes the aggregation weight; rng keyed by global partner id).
+        Measure both modes on chip before picking one for a long sweep."""
+        pow2 = self._slot_pow2
+
+        def width(n: int) -> int:
+            if not pow2:
+                return n
+            return min(1 << (n - 1).bit_length(), self.partners_count)
+
+        by_width: dict[int, list[tuple]] = {}
         for s in multis:
-            by_size.setdefault(len(s), []).append(s)
-        return [(size, by_size[size]) for size in sorted(by_size)]
+            by_width.setdefault(width(len(s)), []).append(s)
+        return [(w, by_width[w]) for w in sorted(by_width)]
 
     def not_twice_characteristic(self, subset) -> float:
         """Reference-API single-subset entry (contributivity.py:92-136)."""

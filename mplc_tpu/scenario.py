@@ -188,6 +188,9 @@ class Scenario:
         self.partner_shards = 1 if partner_shards is None else int(partner_shards)
         if self.partner_shards < 1:
             raise ValueError(f"partner_shards must be >= 1, got {partner_shards}")
+        # set by the CharacteristicEngine once it picks its execution mode
+        # (exact / pow2 slot bucketing, or the masked path)
+        self.slot_bucketing = None
 
         # -- contributivity methods -------------------------------------
         self.contributivity_list: list[Contributivity] = []
@@ -377,6 +380,7 @@ class Scenario:
             "multi_partner_learning_approach": self.multi_partner_learning_approach_key,
             "aggregation": self.aggregation_name,
             "partner_shards": self.partner_shards,
+            "slot_bucketing": self.slot_bucketing,
             "epoch_count": self.epoch_count,
             "minibatch_count": self.minibatch_count,
             "gradient_updates_per_pass_count": self.gradient_updates_per_pass_count,

@@ -49,3 +49,17 @@ run_config 3
 run_config 4
 run_config 5
 echo "$(date +%T) suite done"
+
+# Compile-vs-padding tradeoff (VERDICT r3 #3): re-run the north star with
+# power-of-two slot bucketing (4 compiled pipelines instead of 9) and with
+# a second back-to-back run to measure whether the persistent compile
+# cache reloads on TPU (cold-to-warm delta). Opt out with SKIP_EXTRAS=1.
+if [ "${SKIP_EXTRAS:-0}" != "1" ]; then
+    OUTBAK=$OUT
+    OUT="$OUTBAK/pow2";  mkdir -p "$OUT"
+    run_config 1 BENCH_PARTNERS=10 MPLC_TPU_SLOT_POW2=1
+    OUT="$OUTBAK/warm";  mkdir -p "$OUT"
+    run_config 1 BENCH_PARTNERS=10   # same-process-count rerun: warm cache?
+    OUT=$OUTBAK
+    echo "$(date +%T) extras done"
+fi

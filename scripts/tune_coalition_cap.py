@@ -37,7 +37,8 @@ def main():
     # the sweep must measure the default engine path: ambient engine-mode
     # knobs would silently change what is being timed (the sharding tests
     # delenv these for the same reason)
-    for knob in ("MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_NO_SLOTS"):
+    for knob in ("MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_NO_SLOTS",
+                 "MPLC_TPU_SLOT_POW2"):
         if os.environ.pop(knob, None) is not None:
             print(f"[tune] ignoring ambient {knob}", file=sys.stderr)
 

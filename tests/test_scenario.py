@@ -64,17 +64,31 @@ def test_partner_shards_param_recorded(tiny_image_dataset):
 def test_console_level_switchable_at_runtime(capsys):
     import logging
     from mplc_tpu import utils
-    utils.init_logger(debug=False)
     logger = logging.getLogger("mplc_tpu")
-    logger.debug("hidden-dbg")
-    utils.set_console_level("DEBUG")
-    logger.debug("shown-dbg")
-    utils.set_console_level(logging.INFO)
-    logger.debug("hidden-again")
-    out = capsys.readouterr().out
-    assert "shown-dbg" in out
-    assert "hidden-dbg" not in out
-    assert "hidden-again" not in out
+    saved_handlers = list(logger.handlers)
+    saved_level = utils._console_filter.level
+    try:
+        utils.init_logger(debug=False)
+        logger.debug("hidden-dbg")
+        utils.set_console_level("DEBUG")
+        logger.debug("shown-dbg")
+        utils.set_console_level(logging.INFO)
+        logger.debug("hidden-again")
+        with pytest.raises(ValueError, match="unknown log level"):
+            utils.set_console_level("verbose")
+        out = capsys.readouterr().out
+        assert "shown-dbg" in out
+        assert "hidden-dbg" not in out
+        assert "hidden-again" not in out
+    finally:
+        # init_logger bound a StreamHandler to pytest's capture stream;
+        # restore the original handlers so later tests don't log into a
+        # closed file
+        for h in list(logger.handlers):
+            logger.removeHandler(h)
+        for h in saved_handlers:
+            logger.addHandler(h)
+        utils._console_filter.level = saved_level
 
 
 def test_unknown_method_raises(tiny_image_dataset):

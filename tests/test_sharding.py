@@ -155,6 +155,35 @@ def test_engine_2d_partner_sharded_matches_default(monkeypatch):
         CharacteristicEngine(scenario())
 
 
+def test_slot_pow2_bucketing_matches_exact(monkeypatch):
+    """MPLC_TPU_SLOT_POW2=1 rounds slot widths up to powers of two (fewer
+    compiled pipelines for cold runs). Inactive slots are masked out of the
+    aggregation, so the full v(S) table must match the tight per-size
+    grouping to float tolerance — and only the bucketed widths compile."""
+    from helpers import build_scenario
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+    from mplc_tpu.contrib.shapley import powerset_order
+
+    def scenario():
+        return build_scenario(partners_count=5,
+                              amounts_per_partner=[0.1, 0.15, 0.2, 0.25, 0.3],
+                              dataset_name="titanic", epoch_count=2,
+                              gradient_updates_per_pass_count=2, seed=11)
+
+    subsets = powerset_order(5)
+    monkeypatch.delenv("MPLC_TPU_SLOT_POW2", raising=False)
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    ref_eng = CharacteristicEngine(scenario())
+    ref_vals = ref_eng.evaluate(subsets)
+    assert sorted(ref_eng._slot_pipes) == [2, 3, 4, 5]
+
+    monkeypatch.setenv("MPLC_TPU_SLOT_POW2", "1")
+    eng = CharacteristicEngine(scenario())
+    vals = eng.evaluate(subsets)
+    np.testing.assert_allclose(vals, ref_vals, atol=1e-4)
+    assert sorted(eng._slot_pipes) == [2, 4, 5]  # 3->4; 5 capped at P
+
+
 def test_engine_2d_mode_via_scenario_param(monkeypatch):
     """`partner_shards` as a Scenario/YAML parameter (no env var) selects
     the 2-D engine mode; the env var still overrides, and the effective
