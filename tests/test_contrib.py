@@ -186,6 +186,32 @@ def test_is_lin_additive_stratified_mode(monkeypatch):
     assert np.allclose(c.contributivity_scores, PHI5, atol=0.02)
 
 
+def test_is_lin_large_n_auto_selects_stratified():
+    """At n=20 (n-1 > MAX_EXACT_BITS) the IS methods switch to the
+    size-stratified sampler automatically; the estimator must still recover
+    the additive game's values — and do it without tabulating 2^19 subsets
+    (a few seconds of host work; the exact table would be minutes and GBs).
+    """
+    import time
+    from mplc_tpu.contrib.sampling import (SizeStratifiedSubsetSampler,
+                                           make_importance_sampler)
+    n = 20
+    # deterministic guard: the default factory picks the stratified sampler
+    # at this n (the timing bound below is the backstop for regressions
+    # that reintroduce exponential host work some other way)
+    s = make_importance_sampler(
+        n, 0, lambda masks: np.ones(masks.shape[0]), np.random.default_rng(0))
+    assert isinstance(s, SizeStratifiedSubsetSampler)
+    phi = list(np.linspace(0.01, 0.2, n))
+    sc = fake_scenario(n, additive(phi))
+    c = Contributivity(sc)
+    t0 = time.perf_counter()
+    c.IS_lin(sv_accuracy=0.05, alpha=0.95)
+    host_elapsed = time.perf_counter() - t0
+    assert np.allclose(c.contributivity_scores, phi, atol=0.02)
+    assert host_elapsed < 60  # ~2-4 s normally; enumeration would be >>this
+
+
 def test_is_reg_additive():
     phi = [0.1, 0.2, 0.3, 0.15, 0.25]
     sc = fake_scenario(5, additive(phi))
