@@ -157,6 +157,17 @@ def synthetic_image_classification(rng: np.random.Generator, n: int,
     return np.clip(x, 0.0, 1.0).astype(np.float32), y.astype(np.int64)
 
 
+def upsample_digits_28x28(imgs: np.ndarray) -> np.ndarray:
+    """[N, 8, 8] sklearn-digits images (0..16 ints) -> [N, 28, 28] float32
+    in [0, 1], nearest-neighbor 3x upsample centered on the MNIST canvas.
+    One copy of the geometry, shared by the synthetic-MNIST prototype
+    stock below and the real-digits e2e gate (tests/test_e2e.py)."""
+    up = np.kron(imgs / 16.0, np.ones((3, 3)))  # 8x8 -> 24x24
+    out = np.zeros((len(imgs), 28, 28), np.float32)
+    out[:, 2:26, 2:26] = up
+    return out
+
+
 def _digits_prototypes() -> np.ndarray | None:
     """Real handwritten-digit prototypes from sklearn's bundled digits set,
     upsampled to 28x28 (no network needed)."""
@@ -165,12 +176,9 @@ def _digits_prototypes() -> np.ndarray | None:
     except Exception:
         return None
     d = load_digits()
-    imgs = d.images / 16.0  # [1797, 8, 8]
-    protos = np.zeros((10, 28, 28), np.float32)
-    for c in range(10):
-        mean_img = imgs[d.target == c].mean(axis=0)
-        up = np.kron(mean_img, np.ones((3, 3)))  # 8x8 -> 24x24
-        protos[c, 2:26, 2:26] = up
+    protos = np.stack([
+        upsample_digits_28x28(d.images[d.target == c]).mean(axis=0)
+        for c in range(10)])
     return protos
 
 

@@ -7,9 +7,10 @@ oracle that a partner holding 90% of the data must out-score a partner
 holding 10%, for every method.
 
 Compile budget: XLA CPU compiles of the conv models dominate suite time, so
-exactly ONE test here trains the heavyweight CNN — and it reuses the
-`quick_scenario` shapes/config so the program is shared with test_mpl and the
-persistent compilation cache. The oracle and method-coverage tests run the
+only TWO tests here train the heavyweight CNN — the threshold e2e and the
+real-digits gate — and both use the `quick_scenario` shapes/config so ONE
+compiled program is shared between them, test_mpl, and the persistent
+compilation cache. The oracle and method-coverage tests run the
 same full pipeline on models that compile in seconds (titanic logistic
 regression; a tiny categorical MLP for lflip/PVRL).
 """
@@ -40,6 +41,44 @@ def test_scenario_run_trains_to_threshold(tiny_image_dataset):
     # artifacts written
     assert (sc.save_folder / "graphs" / "data_distribution.png").exists()
     assert (sc.save_folder / "model" / "mnist_final_weights.npz").exists()
+
+
+def _digits_dataset():
+    """REAL handwritten-digit data without network egress: sklearn's bundled
+    UCI digits set (1797 genuine 8x8 scans), upsampled per-image to the
+    28x28x1 MNIST geometry. Subsampled to the tiny_image_dataset sizes
+    (700 train / 150 test) so the scenario below shares its compiled
+    programs with the CNN e2e and test_mpl."""
+    from sklearn.datasets import load_digits
+
+    from mplc_tpu.data.datasets import to_categorical, upsample_digits_28x28
+    from mplc_tpu.models import MNIST_CNN
+
+    d = load_digits()
+    x = upsample_digits_28x28(d.images)[..., None]
+    y = to_categorical(d.target, 10)
+    idx = np.random.default_rng(42).permutation(len(x))
+    tr, te = idx[:700], idx[700:850]
+    return Dataset("mnist", (28, 28, 1), 10, x[tr], y[tr], x[te], y[te],
+                   model=MNIST_CNN, provenance="sklearn-digits")
+
+
+@pytest.mark.slow
+def test_real_digits_quality_gate():
+    """The real-data pipeline proven on data that EXISTS on this box: the
+    reference's CI quality gate runs on downloaded MNIST
+    (end_to_end_tests.py:31-42), which zero-egress boxes can't fetch — the
+    mnist.npz-gated tests below stay skipped here. This one runs the same
+    fedavg pipeline on sklearn's bundled REAL handwritten digits instead,
+    same scenario config as test_scenario_run_trains_to_threshold (shared
+    compiled program), with the threshold the real data supports at this
+    tiny epoch budget."""
+    sc = Scenario(partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+                  dataset=_digits_dataset(), epoch_count=4, minibatch_count=2,
+                  gradient_updates_per_pass_count=4, is_early_stopping=False,
+                  experiment_path="/tmp/mplc_tpu_tests", seed=3)
+    sc.run()
+    assert sc.mpl.history.score > 0.7
 
 
 def _real_mnist_or_skip():
