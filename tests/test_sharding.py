@@ -43,6 +43,71 @@ def _logreg_scenario():
                           gradient_updates_per_pass_count=2, seed=9)
 
 
+def _collectives_in(hlo: str) -> list:
+    return [op for op in
+            ("all-reduce", "all-gather", "all-to-all",
+             "collective-permute", "reduce-scatter",
+             "collective-broadcast")
+            if op in hlo]
+
+
+def test_sharded_sweep_hlo_is_collective_free(monkeypatch):
+    """Compiler-level lock on the zero-communication coal axis: the
+    8-device GSPMD epoch-chunk programs the engine actually runs — BOTH the
+    slot-execution path every fedavg sweep trains on (int32 slot ids,
+    production default) and the masked full-width path (MPLC_TPU_NO_SLOTS /
+    non-fedavg approaches) — must contain NO cross-device collective ops,
+    with the engine's exact committed-input pattern (coal ids and rngs
+    sharded P('coal'), data replicated). The linear v5e-8 projection in
+    perf/ (single-chip seconds / n_chips) rests on this property; if a code
+    change ever introduces a collective into a training body, this test
+    names it and the path it appeared on."""
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+
+    eng = CharacteristicEngine(_logreg_scenario())
+    assert eng._sharding is not None and eng._sharding.num_devices == 8
+    assert eng._use_slots  # fedavg: the sweep really runs slot pipelines
+    P = eng.partners_count
+    B = 8  # one coalition per device
+    rngs_host = jax.numpy.stack(
+        [eng._coalition_rng((i % P,)) for i in range(B)])
+    rngs = jax.device_put(rngs_host, eng._sharding.batch_sharding)
+
+    found = {}
+    # -- slot path: the program the north-star sweep executes (k=2 slots) --
+    k = 2
+    pipe = eng._slot_pipe(k)
+    coal = np.full((B, k), -1, np.int32)
+    coal[:, 0] = 0
+    coal[np.arange(B) % 2 == 0, 1] = 1
+    coal = jax.device_put(jax.numpy.asarray(coal),
+                          eng._sharding.batch_sharding)
+    state = pipe._init(rngs, P)
+    hlo = pipe.trainer.jit_batched_epoch_chunk.lower(
+        state, eng.stacked, eng.val, coal, rngs,
+        pipe.trainer.cfg.epoch_count).compile().as_text()
+    found["slot"] = _collectives_in(hlo)
+
+    # -- masked full-width path (MPLC_TPU_NO_SLOTS / seq approaches) ------
+    pipe = eng.multi_pipe
+    coal = np.zeros((B, P), np.float32)
+    coal[:, 0] = 1.0
+    coal[np.arange(B) % 2 == 0, 1] = 1.0
+    coal = jax.device_put(jax.numpy.asarray(coal),
+                          eng._sharding.batch_sharding)
+    state = pipe._init(rngs, P)
+    hlo = pipe.trainer.jit_batched_epoch_chunk.lower(
+        state, eng.stacked, eng.val, coal, rngs,
+        pipe.trainer.cfg.epoch_count).compile().as_text()
+    found["masked"] = _collectives_in(hlo)
+
+    bad = {path: ops for path, ops in found.items() if ops}
+    assert not bad, (
+        f"sharded epoch-chunk program now contains collectives {bad}; the "
+        "zero-communication scaling claim no longer holds")
+
+
 def test_engine_shards_over_devices():
     """The characteristic engine must produce correct per-coalition scores
     when the mask batch is sharded over all 8 devices."""
