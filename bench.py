@@ -104,7 +104,7 @@ def _start_stall_watchdog(platform: str):
                 # leave the wedged process hung forever.
                 try:
                     if _fallback_allowed():
-                        os._exit(_spawn_cpu_fallback())
+                        os._exit(_fallback_exit())
                 finally:
                     os._exit(4)
 
@@ -144,6 +144,60 @@ def _devices_with_deadline():
 def _fallback_allowed() -> bool:
     return (os.environ.get("BENCH_CPU_FALLBACK", "1") != "0"
             and not os.environ.get("BENCH_IS_FALLBACK_CHILD"))
+
+
+def _replay_cached_tpu_result() -> bool:
+    """Tunnel down and this is the driver-shaped run (default config):
+    prefer re-emitting a real TPU measurement of the SAME workload recorded
+    earlier (scripts/r5_queue.sh runs the driver-shaped bench the moment
+    the tunnel answers and saves the line to perf/r*/config1.json) over a
+    reduced CPU-fallback number. The metric is suffixed `_cached` and the
+    provenance (file, mtime) goes to stderr — this is a replayed
+    measurement, never a fresh one. Returns True when a line was emitted."""
+    if (os.environ.get("BENCH_CONFIG", "1") != "1"
+            or os.environ.get("BENCH_PARTNERS", "10") != "10"
+            or os.environ.get("BENCH_EPOCHS", "8") != "8"
+            or os.environ.get("BENCH_DATASET", "mnist") != "mnist"
+            or os.environ.get("BENCH_METRIC_SUFFIX")):
+        return False
+    import glob
+    repo = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in glob.glob(os.path.join(repo, "perf", "r*", "config1.json")):
+        try:
+            with open(path) as f:
+                rec = json.loads(f.read().strip())
+        except (OSError, ValueError):
+            continue
+        metric = rec.get("metric", "")
+        if ("_cpu_fallback" in metric or "_cached" in metric
+                or not metric.startswith("exact_shapley_mnist_10partners_8epochs")
+                or not isinstance(rec.get("value"), (int, float))
+                or "unit" not in rec):
+            continue
+        mtime = os.path.getmtime(path)
+        if best is None or mtime > best[0]:
+            best = (mtime, path, rec)
+    if best is None:
+        return False
+    mtime, path, rec = best
+    print(f"[bench] tunnel unreachable — replaying the TPU measurement from "
+          f"{os.path.relpath(path, repo)} (file mtime "
+          f"{time.strftime('%Y-%m-%d %H:%M UTC', time.gmtime(mtime))}; "
+          f"approximate if the tree was re-checked-out); the metric is "
+          f"suffixed _cached: it is NOT a fresh run",
+          file=sys.stderr, flush=True)
+    print(json.dumps({"metric": rec["metric"] + "_cached",
+                      "value": rec["value"], "unit": rec["unit"],
+                      "vs_baseline": rec.get("vs_baseline")}))
+    return True
+
+
+def _fallback_exit() -> int:
+    """Best available degraded result: cached TPU replay, else CPU child."""
+    if _replay_cached_tpu_result():
+        return 0
+    return _spawn_cpu_fallback()
 
 
 def _spawn_cpu_fallback() -> int:
@@ -326,8 +380,13 @@ def _peak_flops_per_chip():
     for k, v in table.items():
         if k in kind:
             return v
-    print(f"[bench] unknown device_kind {kind!r}: no bf16-peak entry, "
-          f"MFU line suppressed", file=sys.stderr)
+    if kind == "cpu":
+        # the CPU-fallback path, not a gap in the table: MFU is a TPU
+        # metric and simply doesn't apply here
+        print("[bench] host-CPU run: MFU not applicable", file=sys.stderr)
+    else:
+        print(f"[bench] unknown device_kind {kind!r}: no bf16-peak entry, "
+              f"MFU line suppressed", file=sys.stderr)
     return None
 
 
@@ -476,7 +535,7 @@ def main():
     epochs = int(os.environ.get("BENCH_EPOCHS", "8"))
     devices = _devices_with_deadline()
     if devices is None:
-        sys.exit(_spawn_cpu_fallback() if _fallback_allowed() else 3)
+        sys.exit(_fallback_exit() if _fallback_allowed() else 3)
     platform = devices[0].platform
     _start_stall_watchdog(platform)
     try:

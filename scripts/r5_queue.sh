@@ -1,0 +1,123 @@
+#!/bin/bash
+# Round-5 priority-ordered TPU measurement queue (VERDICT r4 "Next round").
+#
+# Probes the axon tunnel with a bounded jax.devices() every 5 min (it wedges
+# for multi-hour stretches — DESIGN_NOTES.md) and, whenever it answers, runs
+# the next unfinished step. Steps are idempotent: a step whose .json output
+# already holds a metric line is skipped, so the script can be restarted (or
+# the tunnel can die mid-queue) without redoing finished work.
+#
+# Priority order (VERDICT r4 tasks 1, 2, 3, 4, 6):
+#   1. width-scaling curve  — per-device widths 1/2/4/8/16 at fixed size 5;
+#      the input the 300 s-bar v5e-8 projection is missing (task 1)
+#   2. config 1, driver-shaped (plain bench.py) — north-star re-run; its
+#      warm-up line also measures compile-cache reload (219 r4 entries are
+#      on disk in .jax_cache), and its metric becomes the cached-TPU replay
+#      for the round-close driver bench (tasks 2, 4)
+#   3. short trace run (6 partners, ~3 min timed) with MPLC_TPU_PROFILE_DIR
+#      — attributes the ~96% non-MFU time (task 3)
+#   4-6. BASELINE configs 3, 4, 5 — the unmet measurement contract (task 2)
+#   7. cap bisect 20/24 — is the cap=32 crash width-specific? (task 6)
+#   8. pow2 north star — compile-count/tail-fill tradeoff, measured (task 4)
+#   9. warm north-star rerun — cold-vs-warm within one tunnel session
+#  10. supplementary methods (IS_reg_S, AIS_Kriging_S, WR_SMC)
+#
+# While a measured phase runs, /tmp/tpu_busy exists: CPU-side background
+# jobs (the n=10 SV-parity run) poll it and pause — the host has ONE core
+# and concurrent CPU load skews host-side timing.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-/root/repo/perf/r5}
+mkdir -p "$OUT"
+BUSY=/tmp/tpu_busy
+trap 'rm -f "$BUSY"' EXIT
+
+probe() {
+    timeout 90 python - <<'EOF'
+import threading, sys
+ok = []
+def init():
+    import jax
+    ok.append(len(jax.devices()))
+t = threading.Thread(target=init, daemon=True)
+t.start(); t.join(75)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+wait_for_tunnel() {
+    rm -f "$BUSY"
+    until probe; do
+        echo "$(date +%T) tunnel down; retrying in 300 s"
+        sleep 300
+    done
+    echo "$(date +%T) tunnel up"
+    touch "$BUSY"
+}
+
+done_step() {  # a step is done when its json output contains a metric line
+    [ -s "$1" ] && grep -q '"metric"' "$1"
+}
+
+run_bench() {  # run_bench <out-prefix> [ENV=V ...]
+    local prefix=$1; shift
+    if done_step "$prefix.json"; then
+        echo "$(date +%T) skip $(basename "$prefix") (already measured)"
+        return 0
+    fi
+    wait_for_tunnel
+    echo "$(date +%T) running $(basename "$prefix"): $*"
+    timeout 5400 env BENCH_CPU_FALLBACK=0 "$@" \
+        python bench.py > "$prefix.json" 2> "$prefix.log"
+    local rc=$?
+    echo "$(date +%T) $(basename "$prefix") exit $rc: $(cat "$prefix.json")"
+}
+
+run_logged() {  # run_logged <logfile> <timeout> <cmd...> — done when log has DONE
+    local log=$1 tmo=$2; shift 2
+    if [ -s "$log" ] && grep -q '^QUEUE-STEP-DONE$' "$log"; then
+        echo "$(date +%T) skip $(basename "$log") (already done)"
+        return 0
+    fi
+    wait_for_tunnel
+    echo "$(date +%T) running $(basename "$log"): $*"
+    timeout "$tmo" "$@" > "$log" 2>&1
+    local rc=$?
+    [ $rc -eq 0 ] && echo 'QUEUE-STEP-DONE' >> "$log"
+    echo "$(date +%T) $(basename "$log") exit $rc"
+}
+
+# 1. width-scaling curve: block 48 = multiple of lcm(1,2,4,8,16), so no
+#    width pays padding; size 5 is the modal slot count of the north star
+run_logged "$OUT/width_curve.log" 3600 \
+    python scripts/tune_coalition_cap.py --size 5 --block 48 \
+    --caps 1,2,4,8,16 --partners 10 --epochs 8
+
+# 2. driver-shaped north star (exact env shape the driver uses: bare bench.py)
+run_bench "$OUT/config1"
+
+# 3. short profiled run: same model/pipelines as the north star, 63 coalitions
+run_bench "$OUT/trace_run" BENCH_PARTNERS=6 MPLC_TPU_PROFILE_DIR="$OUT/trace"
+
+# 4-6. the unmeasured BASELINE configs
+run_bench "$OUT/config3" BENCH_CONFIG=3
+run_bench "$OUT/config4" BENCH_CONFIG=4
+run_bench "$OUT/config5" BENCH_CONFIG=5
+
+# 7. cap bisect: does >16 width survive below 32? (block 120 = lcm(20,24))
+run_logged "$OUT/cap_bisect.log" 3600 \
+    python scripts/tune_coalition_cap.py --size 5 --block 120 \
+    --caps 20,24 --partners 10 --epochs 8
+
+# 8-9. north-star variants: pow2 bucketing, then a warm rerun
+mkdir -p "$OUT/pow2" "$OUT/warm"
+run_bench "$OUT/pow2/config1" MPLC_TPU_SLOT_POW2=1
+run_bench "$OUT/warm/config1"
+
+# 10. supplementary estimator methods
+run_bench "$OUT/config3_isreg" BENCH_CONFIG=3 BENCH_METHOD=IS_reg_S
+run_bench "$OUT/config3_ais" BENCH_CONFIG=3 BENCH_METHOD=AIS_Kriging_S
+run_bench "$OUT/config4_wrsmc" BENCH_CONFIG=4 BENCH_METHOD=WR_SMC
+
+rm -f "$BUSY"
+echo "$(date +%T) r5 queue complete"
