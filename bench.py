@@ -160,6 +160,13 @@ def _replay_cached_tpu_result() -> bool:
             or os.environ.get("BENCH_DATASET", "mnist") != "mnist"
             or os.environ.get("BENCH_METRIC_SUFFIX")):
         return False
+    # any workload-shaping knob off its default makes the cached full-scale
+    # measurement a DIFFERENT workload — same set _spawn_cpu_fallback strips
+    for knob in ("BENCH_DTYPE", "MPLC_TPU_COALITIONS_PER_DEVICE",
+                 "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
+                 "MPLC_TPU_SLOT_POW2", "MPLC_TPU_SYNTH_SCALE"):
+        if os.environ.get(knob):
+            return False
     import glob
     repo = os.path.dirname(os.path.abspath(__file__))
     best = None

@@ -57,9 +57,18 @@ def summarize(pb_path):
             if not n:
                 continue
             span = t_max - t_min
+            # span==0: a line holding one instantaneous event; busy>span:
+            # overlapping async ops (the naive busy sum double-counts) —
+            # flag both rather than print a bogus fraction as fact
+            if span > 0:
+                note = " [overlapping events: busy>span]" if busy > span else ""
+                frac = f"{min(100 * busy / span, 100.0):.1f}% of span"
+            else:
+                note = ""
+                frac = "busy fraction n/a: zero span"
             print(f"\n{plane.name} / {line.name}: {n} events, "
                   f"span {span / 1e9:.3f} s, busy {busy / 1e9:.3f} s "
-                  f"({100 * busy / span:.1f}% of span)")
+                  f"({frac}){note}")
             if line.name == "XLA Ops":
                 top = sorted(per_op.items(), key=lambda kv: -kv[1])[:12]
                 for name, dur in top:

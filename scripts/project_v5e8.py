@@ -28,7 +28,6 @@ import argparse
 import math
 import os
 import re
-import sys
 from math import comb
 
 
@@ -144,15 +143,16 @@ def main():
     t10_w1 = t16.get(10)
 
     models = {}
-    if os.path.exists(args.curve) and parse_width_curve(args.curve):
-        pts = parse_width_curve(args.curve)
+    pts = parse_width_curve(args.curve) if os.path.exists(args.curve) else []
+    if len(pts) >= 2:  # one point (a wedge-truncated log) can't fit a line
         a, c = fit_affine(pts)
         t_16 = a * 16 + c
         models["measured-affine"] = lambda w, a=a, c=c, t=t_16: (a * w + c) / t
         print(f"width curve {args.curve}: t_batch(w) = {a:.3f}*w + {c:.3f} s "
               f"(points: {pts})")
     else:
-        print(f"no width curve at {args.curve} yet — bracketing with priors")
+        print(f"no usable width curve at {args.curve} (need >= 2 points, "
+              f"have {len(pts)}) — bracketing with priors")
     models["linear(optimistic)"] = lambda w: w / 16.0
     models["flat(pessimistic)"] = lambda w: 1.0
 

@@ -55,6 +55,8 @@ wait_for_tunnel() {
     touch "$BUSY"
 }
 
+UNFINISHED=0  # per-pass count: steps still lacking their done marker
+
 done_step() {  # a step is done when its json output contains a metric line
     [ -s "$1" ] && grep -q '"metric"' "$1"
 }
@@ -62,7 +64,6 @@ done_step() {  # a step is done when its json output contains a metric line
 run_bench() {  # run_bench <out-prefix> [ENV=V ...]
     local prefix=$1; shift
     if done_step "$prefix.json"; then
-        echo "$(date +%T) skip $(basename "$prefix") (already measured)"
         return 0
     fi
     wait_for_tunnel
@@ -71,12 +72,12 @@ run_bench() {  # run_bench <out-prefix> [ENV=V ...]
         python bench.py > "$prefix.json" 2> "$prefix.log"
     local rc=$?
     echo "$(date +%T) $(basename "$prefix") exit $rc: $(cat "$prefix.json")"
+    done_step "$prefix.json" || UNFINISHED=$((UNFINISHED + 1))
 }
 
 run_logged() {  # run_logged <logfile> <timeout> <cmd...> — done when log has DONE
     local log=$1 tmo=$2; shift 2
     if [ -s "$log" ] && grep -q '^QUEUE-STEP-DONE$' "$log"; then
-        echo "$(date +%T) skip $(basename "$log") (already done)"
         return 0
     fi
     wait_for_tunnel
@@ -85,39 +86,60 @@ run_logged() {  # run_logged <logfile> <timeout> <cmd...> — done when log has 
     local rc=$?
     [ $rc -eq 0 ] && echo 'QUEUE-STEP-DONE' >> "$log"
     echo "$(date +%T) $(basename "$log") exit $rc"
+    [ $rc -ne 0 ] && UNFINISHED=$((UNFINISHED + 1))
+    return 0
 }
 
-# 1. width-scaling curve: block 48 = multiple of lcm(1,2,4,8,16), so no
-#    width pays padding; size 5 is the modal slot count of the north star
-run_logged "$OUT/width_curve.log" 3600 \
-    python scripts/tune_coalition_cap.py --size 5 --block 48 \
-    --caps 1,2,4,8,16 --partners 10 --epochs 8
+one_pass() {
+    # 1. width-scaling curve: block 48 = multiple of lcm(1,2,4,8,16), so no
+    #    width pays padding; size 5 is the modal slot count of the north star
+    run_logged "$OUT/width_curve.log" 3600 \
+        python scripts/tune_coalition_cap.py --size 5 --block 48 \
+        --caps 1,2,4,8,16 --partners 10 --epochs 8
 
-# 2. driver-shaped north star (exact env shape the driver uses: bare bench.py)
-run_bench "$OUT/config1"
+    # 2. driver-shaped north star (exact env shape the driver uses)
+    run_bench "$OUT/config1"
 
-# 3. short profiled run: same model/pipelines as the north star, 63 coalitions
-run_bench "$OUT/trace_run" BENCH_PARTNERS=6 MPLC_TPU_PROFILE_DIR="$OUT/trace"
+    # 3. short profiled run: same model/pipelines as the north star
+    run_bench "$OUT/trace_run" BENCH_PARTNERS=6 MPLC_TPU_PROFILE_DIR="$OUT/trace"
 
-# 4-6. the unmeasured BASELINE configs
-run_bench "$OUT/config3" BENCH_CONFIG=3
-run_bench "$OUT/config4" BENCH_CONFIG=4
-run_bench "$OUT/config5" BENCH_CONFIG=5
+    # 4-6. the unmeasured BASELINE configs
+    run_bench "$OUT/config3" BENCH_CONFIG=3
+    run_bench "$OUT/config4" BENCH_CONFIG=4
+    run_bench "$OUT/config5" BENCH_CONFIG=5
 
-# 7. cap bisect: does >16 width survive below 32? (block 120 = lcm(20,24))
-run_logged "$OUT/cap_bisect.log" 3600 \
-    python scripts/tune_coalition_cap.py --size 5 --block 120 \
-    --caps 20,24 --partners 10 --epochs 8
+    # 7. cap bisect: does >16 width survive below 32? (block 120 = lcm(20,24))
+    run_logged "$OUT/cap_bisect.log" 3600 \
+        python scripts/tune_coalition_cap.py --size 5 --block 120 \
+        --caps 20,24 --partners 10 --epochs 8
 
-# 8-9. north-star variants: pow2 bucketing, then a warm rerun
-mkdir -p "$OUT/pow2" "$OUT/warm"
-run_bench "$OUT/pow2/config1" MPLC_TPU_SLOT_POW2=1
-run_bench "$OUT/warm/config1"
+    # 8-9. north-star variants: pow2 bucketing, then a warm rerun
+    mkdir -p "$OUT/pow2" "$OUT/warm"
+    run_bench "$OUT/pow2/config1" MPLC_TPU_SLOT_POW2=1
+    run_bench "$OUT/warm/config1"
 
-# 10. supplementary estimator methods
-run_bench "$OUT/config3_isreg" BENCH_CONFIG=3 BENCH_METHOD=IS_reg_S
-run_bench "$OUT/config3_ais" BENCH_CONFIG=3 BENCH_METHOD=AIS_Kriging_S
-run_bench "$OUT/config4_wrsmc" BENCH_CONFIG=4 BENCH_METHOD=WR_SMC
+    # 10. supplementary estimator methods
+    run_bench "$OUT/config3_isreg" BENCH_CONFIG=3 BENCH_METHOD=IS_reg_S
+    run_bench "$OUT/config3_ais" BENCH_CONFIG=3 BENCH_METHOD=AIS_Kriging_S
+    run_bench "$OUT/config4_wrsmc" BENCH_CONFIG=4 BENCH_METHOD=WR_SMC
+}
 
+# A step that dies mid-run (tunnel wedge, timeout, watchdog exit 4) must be
+# retried IN PRIORITY ORDER on the next pass, not abandoned: each pass
+# re-walks the whole list (finished steps skip instantly), so a recovered
+# tunnel always resumes from the highest-priority unfinished measurement.
+for pass in 1 2 3 4 5 6 7 8 9 10; do
+    UNFINISHED=0
+    echo "$(date +%T) queue pass $pass"
+    one_pass
+    if [ "$UNFINISHED" -eq 0 ]; then
+        rm -f "$BUSY"
+        echo "$(date +%T) r5 queue complete: every step has its artifact"
+        exit 0
+    fi
+    echo "$(date +%T) pass $pass ended with $UNFINISHED unfinished step(s); retrying"
+    sleep 60
+done
 rm -f "$BUSY"
-echo "$(date +%T) r5 queue complete"
+echo "$(date +%T) r5 queue giving up after 10 passes; unfinished steps remain"
+exit 1
