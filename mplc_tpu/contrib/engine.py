@@ -131,7 +131,10 @@ class Batched2DTrainerPipeline(BatchedTrainerPipeline):
                             in_axes=(0, None, None, 0, 0, None))(
                 state, stacked, val, masks, rngs, n_epochs)
 
-        run_cache = {}
+        # keyed by n_epochs; exposed as an attribute so the compiler-level
+        # sharding tests can .lower() the exact jitted program this
+        # pipeline executes (tests/test_sharding.py)
+        self._run_cache = run_cache = {}
 
         def run(state, stacked, val, masks, rngs, n_epochs):
             if n_epochs not in run_cache:

@@ -385,3 +385,83 @@ def test_full_ten_partner_sweep_sharded():
     # efficiency: SVs sum to v(grand coalition)
     grand = eng.charac_fct_values[tuple(range(10))]
     assert np.isclose(sv.sum(), grand, atol=1e-5)
+
+
+def test_2d_partner_sharded_hlo_collective_budget(monkeypatch):
+    """Compiler-level lock on the 2-D [coal x part] path's communication
+    budget (the partner-sharded analogue of the zero-collective coal-axis
+    lock above): the epoch-chunk program may communicate ONLY via
+    all-reduce (the per-aggregation psum over `part` —
+    parallel/partner_shard.py), every all-reduce must ride the part axis
+    alone (replica groups of size part_shards, never the whole mesh), no
+    other collective kind may appear, and the static all-reduce count must
+    stay small (one fused psum per aggregation site, not one per training
+    step or per parameter). A regression that all-gathers the stacked
+    data, psums over `coal`, or aggregates per-step would trip one of
+    these three asserts by name."""
+    import re
+
+    from helpers import build_scenario
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+
+    monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "2")
+    eng = CharacteristicEngine(build_scenario(
+        partners_count=4, amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
+        dataset_name="titanic", epoch_count=2,
+        gradient_updates_per_pass_count=2, seed=9))
+    pipe = eng._pipe2d
+    assert pipe is not None and pipe.part_shards == 2
+
+    B = pipe.coal_devices  # one coalition per coal-mesh row
+    P_count = eng.partners_count
+    coal = np.zeros((B, P_count), np.float32)
+    coal[:, 0] = 1.0
+    coal[np.arange(B) % 2 == 0, 1] = 1.0
+    coal = jax.device_put(jax.numpy.asarray(coal), pipe.batch_sharding)
+    rngs = jax.device_put(
+        jax.numpy.stack([eng._coalition_rng((i % P_count,)) for i in range(B)]),
+        pipe.rng_sharding)
+    state = pipe._init(rngs, P_count)
+    n = pipe.trainer.cfg.epoch_count
+    pipe._run(state, eng.stacked, eng.val, coal, rngs, n)  # populate cache
+    hlo = pipe._run_cache[n].lower(
+        state, eng.stacked, eng.val, coal, rngs).compile().as_text()
+
+    forbidden = [op for op in _collectives_in(hlo) if op != "all-reduce"]
+    assert not forbidden, (
+        f"2-D epoch-chunk program now contains {forbidden}; the "
+        "partner-sharded path must communicate via psum/all-reduce only")
+
+    ar_lines = [ln for ln in hlo.splitlines() if "all-reduce" in ln
+                and "replica_groups" in ln]
+    assert ar_lines, "partner aggregation no longer produces any all-reduce"
+
+    group_sizes = set()
+    for ln in ar_lines:
+        m = re.search(r"replica_groups=\{\{([^}]*)\}", ln)
+        if m:  # explicit list form: {{0,1},{2,3},...} — first group
+            group_sizes.add(len(m.group(1).split(",")))
+            continue
+        # plain iota form: [n_groups, group_size] <= [n_devices] — the
+        # transposed form ([a,b]<=[c,d]T(...)) has two dims after <= and
+        # deliberately does NOT match; it falls through to the hard fail
+        m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=\[(\d+)\]", ln)
+        if m:
+            group_sizes.add(int(m.group(2)))
+            continue
+        # any other form (e.g. the transposed iota XLA uses for groups
+        # along the major mesh axis) must fail the lock loudly, not
+        # slip past it unparsed
+        raise AssertionError(f"unrecognized replica_groups format in: {ln}")
+    assert group_sizes == {pipe.part_shards}, (
+        f"all-reduce replica groups {group_sizes} != part axis width "
+        f"{pipe.part_shards}: a collective is riding more than `part`")
+
+    # Measured budget: XLA emits exactly 2 static all-reduce sites for this
+    # program (one tuple-fused params aggregation + one scalar psum),
+    # reused across loop iterations via channel ids — NOT one per training
+    # step. 8 leaves headroom for metric additions; a per-step or per-leaf
+    # blowup lands far above it.
+    assert len(ar_lines) <= 8, (
+        f"{len(ar_lines)} all-reduces in one epoch chunk — the aggregation "
+        "psum is no longer fused/hoisted as budgeted")
