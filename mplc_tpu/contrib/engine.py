@@ -64,20 +64,56 @@ class BatchedTrainerPipeline:
                base_rng) -> tuple[np.ndarray, np.ndarray]:
         """Returns (test_accuracies, epochs_trained) per coalition in the
         batch — epochs_trained feeds the engine's throughput accounting."""
+        return self.scores_async(masks, rngs, stacked, val, test, base_rng)()
+
+    @property
+    def dispatches_async(self) -> bool:
+        """True when the whole batch is one dispatch chain with no host
+        decision inside — the precondition for overlapping two batches."""
         cfg = self.trainer.cfg
-        state = self._init(rngs, self.partners_count)
         chunk = cfg.patience if cfg.is_early_stopping else cfg.epoch_count
         chunk = max(1, min(chunk, cfg.epoch_count))
-        epochs_left = cfg.epoch_count
-        while epochs_left > 0:
-            n = min(chunk, epochs_left)
-            state = self._run(state, stacked, val, masks, rngs, n)
-            epochs_left -= n
-            if bool(jax.device_get(jnp.all(state.done))):
-                break
+        return not cfg.is_early_stopping or chunk >= cfg.epoch_count
+
+    def scores_async(self, masks: jnp.ndarray, rngs: jnp.ndarray, stacked,
+                     val, test, base_rng):
+        """Dispatch the batch and return a zero-argument harvest thunk.
+
+        With early stopping OFF (the bench/sweep configuration: one
+        epoch-chunk spans the whole run) everything is dispatched
+        asynchronously and the thunk blocks on the device arrays — so a
+        caller can prep and dispatch the NEXT batch while this one
+        computes (engine batch pipelining, MPLC_TPU_PIPELINE_BATCHES).
+        With early stopping ON, the per-chunk host check (`all(done)`)
+        forces a sync loop; the work is complete before the thunk is
+        built and the thunk only fetches."""
+        cfg = self.trainer.cfg
+        state = self._init(rngs, self.partners_count)
+        if self.dispatches_async:
+            # single-chunk program: no host decision inside — stay async.
+            # (A one-chunk ES run still never early-stops mid-chunk, so
+            # skipping the post-chunk `done` fetch changes nothing.)
+            state = self._run(state, stacked, val, masks, rngs, cfg.epoch_count)
+        else:
+            chunk = max(1, min(cfg.patience, cfg.epoch_count))
+            epochs_left = cfg.epoch_count
+            while epochs_left > 0:
+                n = min(chunk, epochs_left)
+                state = self._run(state, stacked, val, masks, rngs, n)
+                epochs_left -= n
+                if bool(jax.device_get(jnp.all(state.done))):
+                    break
         _, accs = self._fin(state, test)
-        return (np.asarray(jax.device_get(accs)),
-                np.asarray(jax.device_get(state.nb_epochs_done)))
+        # close over the two small result arrays ONLY: holding the full
+        # state pytree would pin the batch's params + optimizer buffers in
+        # HBM until harvest — the dominant share of the in-flight footprint
+        epochs_done = state.nb_epochs_done
+
+        def harvest():
+            return (np.asarray(jax.device_get(accs)),
+                    np.asarray(jax.device_get(epochs_done)))
+
+        return harvest
 
 
 class Batched2DTrainerPipeline(BatchedTrainerPipeline):
@@ -216,6 +252,13 @@ class CharacteristicEngine:
         self._use_slots = (multi_cfg.approach == "fedavg"
                            and os.environ.get("MPLC_TPU_NO_SLOTS") != "1")
         self._slot_pow2 = os.environ.get("MPLC_TPU_SLOT_POW2") == "1"
+        # Batch pipelining: dispatch batch i+1 while batch i computes, so
+        # the device never idles through host-side mask building, transfers
+        # and result fetches between batches (the dispatch-gap component of
+        # the non-MFU time). Opt-in until chip-measured; results are
+        # identical (same executables, same per-coalition rng streams —
+        # only the harvest point moves).
+        self._pipeline_batches = os.environ.get("MPLC_TPU_PIPELINE_BATCHES") == "1"
         self._slot_pipes: dict[int, BatchedTrainerPipeline] = {}
 
         # 2-D [coal, part] mode (MPLC_TPU_PARTNER_SHARDS=p): shard the
@@ -304,7 +347,8 @@ class CharacteristicEngine:
             if not bits:
                 return key
 
-    def _device_batch_cap(self, slot_count: int | None = None) -> int:
+    def _device_batch_cap(self, slot_count: int | None = None,
+                          overlap: bool = False) -> int:
         """Coalitions per device per compiled batch.
 
         Ceiling = constants.MAX_COALITIONS_PER_DEVICE_BATCH (16): larger
@@ -338,6 +382,12 @@ class CharacteristicEngine:
         except Exception:
             hbm = 8 << 30
         fit = max(1, int(0.5 * hbm / max(per_coal, 1)))
+        if overlap:
+            # two batches genuinely in flight — halve the memory-derived
+            # cap (the explicit env override above is left to the operator;
+            # on a chip where the constant MAX binds instead of memory, as
+            # on v5e with the tiny sweep models, this changes nothing)
+            fit = max(1, fit // 2)
         return min(constants.MAX_COALITIONS_PER_DEVICE_BATCH, fit)
 
     def _slot_pipe(self, k: int) -> BatchedTrainerPipeline:
@@ -349,54 +399,86 @@ class CharacteristicEngine:
 
     def _run_batch(self, subsets: list[tuple], pipe,
                    slot_count: int | None = None) -> None:
+        # overlap is only possible when the pipe dispatches without host
+        # decisions inside (no mid-run ES sync) — otherwise pipelining
+        # degenerates to the sequential path and must not halve the cap
+        overlap = self._pipeline_batches and pipe.dispatches_async
         if getattr(pipe, "coal_devices", None):
             n_dev = pipe.coal_devices          # 2-D mesh: coal axis only
             # each device holds only partners_count / part_shards partner
             # model copies — cap on the LOCAL count, not the global one
-            cap = self._device_batch_cap(pipe._local_partners)
+            cap = self._device_batch_cap(pipe._local_partners, overlap)
         else:
             n_dev = max(self._sharding.num_devices if self._sharding else 1, 1)
-            cap = self._device_batch_cap(slot_count)
+            cap = self._device_batch_cap(slot_count, overlap)
         # ONE bucket width for the whole call (the tail group pads up to it
         # rather than compiling its own smaller-width program) — so a warm-up
         # pass over min(len, n_dev*cap) subsets per size compiles exactly
         # the programs a full sweep executes.
         b = _bucket_size(min(len(subsets), n_dev * cap), n_dev, cap)
-        i = 0
-        while i < len(subsets):
-            group = subsets[i:i + b]
-            i += len(group)
-            padded = list(group) + [group[0]] * (b - len(group))
-            if slot_count is not None:
-                coal = np.full((b, slot_count), -1, np.int32)
-                for j, s in enumerate(padded):
-                    coal[j, :len(s)] = sorted(s)
-            else:
-                coal = np.zeros((b, self.partners_count), np.float32)
-                for j, s in enumerate(padded):
-                    coal[j, list(s)] = 1.0
-            rngs = jnp.stack([self._coalition_rng(s) for s in padded])
-            coal = jnp.asarray(coal)
-            if getattr(pipe, "batch_sharding", None) is not None:
-                coal = jax.device_put(coal, pipe.batch_sharding)
-                rngs = jax.device_put(rngs, pipe.rng_sharding)
-            elif self._sharding is not None:
-                coal = jax.device_put(coal, self._sharding.batch_sharding)
-                rngs = jax.device_put(rngs, self._sharding.batch_sharding)
-            accs, epochs = pipe.scores(coal, rngs, self.stacked, self.val,
-                                       self.test, self._coalition_rng(()))
-            per_partner = (self._epoch_samples_single
-                           if pipe is self.single_pipe
-                           else self._epoch_samples_multi)
-            for s, acc, ep in zip(group, accs[:len(group)], epochs[:len(group)]):
-                self._store(s, float(acc))
-                self.epochs_trained += int(ep)
-                self.samples_trained += int(ep) * int(
-                    sum(int(per_partner[i]) for i in s))
-            if self.autosave_path is not None:
-                self.save_cache(self.autosave_path)
-            if self.progress is not None:
-                self.progress(len(group), len(subsets) - i, slot_count)
+        per_partner = (self._epoch_samples_single
+                       if pipe is self.single_pipe
+                       else self._epoch_samples_multi)
+
+        pending = None  # (group, fetch-thunk, remaining-after) in flight
+        try:
+            i = 0
+            while i < len(subsets):
+                group = subsets[i:i + b]
+                i += len(group)
+                padded = list(group) + [group[0]] * (b - len(group))
+                if slot_count is not None:
+                    coal = np.full((b, slot_count), -1, np.int32)
+                    for j, s in enumerate(padded):
+                        coal[j, :len(s)] = sorted(s)
+                else:
+                    coal = np.zeros((b, self.partners_count), np.float32)
+                    for j, s in enumerate(padded):
+                        coal[j, list(s)] = 1.0
+                rngs = jnp.stack([self._coalition_rng(s) for s in padded])
+                coal = jnp.asarray(coal)
+                if getattr(pipe, "batch_sharding", None) is not None:
+                    coal = jax.device_put(coal, pipe.batch_sharding)
+                    rngs = jax.device_put(rngs, pipe.rng_sharding)
+                elif self._sharding is not None:
+                    coal = jax.device_put(coal, self._sharding.batch_sharding)
+                    rngs = jax.device_put(rngs, self._sharding.batch_sharding)
+                fetch = pipe.scores_async(coal, rngs, self.stacked, self.val,
+                                          self.test, self._coalition_rng(()))
+                if overlap:
+                    # harvest the PREVIOUS batch only after this one is in
+                    # the device queue: the device crosses batch boundaries
+                    # with zero idle while the host stores/saves/reports
+                    if pending is not None:
+                        self._record_group(*pending, per_partner, slot_count)
+                    pending = (group, fetch, len(subsets) - i)
+                else:
+                    self._record_group(group, fetch, len(subsets) - i,
+                                       per_partner, slot_count)
+            if pending is not None:
+                self._record_group(*pending, per_partner, slot_count)
+                pending = None
+        finally:
+            if pending is not None:
+                # a failed prep/dispatch of the NEXT batch must not lose
+                # the finished one: store + autosave it before unwinding
+                self._record_group(*pending, per_partner, slot_count)
+
+    def _record_group(self, group, fetch, remaining, per_partner,
+                      slot_count) -> None:
+        """Per-batch bookkeeping shared by _run_batch and
+        _run_singles_sliced: fetch results, memoize scores, account
+        epochs/samples, autosave, report progress."""
+        accs, epochs = fetch()
+        for s, acc, ep in zip(group, accs[:len(group)], epochs[:len(group)]):
+            self._store(s, float(acc))
+            self.epochs_trained += int(ep)
+            self.samples_trained += int(ep) * int(
+                sum(int(per_partner[i]) for i in s))
+        if self.autosave_path is not None:
+            self.save_cache(self.autosave_path)
+        if self.progress is not None:
+            self.progress(len(group), remaining, slot_count)
 
     def _run_singles_sliced(self, singles: list[tuple]) -> None:
         """2-D mode singletons: a 1-partner coalition touches only its own
@@ -414,9 +496,13 @@ class CharacteristicEngine:
         coal_sh = NamedSharding(self._pipe2d.mesh, P("coal"))
         rep_sh = NamedSharding(self._pipe2d.mesh, P())
         pipe = BatchedTrainerPipeline(self.single_pipe.trainer, b)
-        # NOTE: bucket/pad/store/autosave/progress below mirrors _run_batch
-        # (which can't be reused directly: the data tensor varies per batch
-        # here); keep the two loops in step when changing either
+        # NOTE: the bucket/pad loop below mirrors _run_batch (which can't
+        # be reused directly: the data tensor varies per batch here); the
+        # per-batch bookkeeping is shared via _record_group. Keep the two
+        # pad loops in step when changing either. Sequential harvest (no
+        # pipelining): the per-batch data slice must be rebuilt host-side
+        # anyway, so overlap buys little here and singles are one batch in
+        # almost every real sweep.
         i = 0
         while i < len(singles):
             group = singles[i:i + b]
@@ -431,17 +517,10 @@ class CharacteristicEngine:
             coal = jax.device_put(jnp.eye(b, dtype=jnp.float32), coal_sh)
             rngs = jax.device_put(
                 jnp.stack([self._coalition_rng(s) for s in padded]), coal_sh)
-            accs, epochs = pipe.scores(coal, rngs, sliced, self.val, self.test,
-                                       self._coalition_rng(()))
-            for s, acc, ep in zip(group, accs[:len(group)], epochs[:len(group)]):
-                self._store(s, float(acc))
-                self.epochs_trained += int(ep)
-                self.samples_trained += int(ep) * int(
-                    self._epoch_samples_single[s[0]])
-            if self.autosave_path is not None:
-                self.save_cache(self.autosave_path)
-            if self.progress is not None:
-                self.progress(len(group), len(singles) - i, None)
+            fetch = pipe.scores_async(coal, rngs, sliced, self.val, self.test,
+                                      self._coalition_rng(()))
+            self._record_group(group, fetch, len(singles) - i,
+                               self._epoch_samples_single, None)
 
     def _store(self, subset: tuple, value: float) -> None:
         self.charac_fct_values[subset] = value

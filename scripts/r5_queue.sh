@@ -113,10 +113,13 @@ one_pass() {
         python scripts/tune_coalition_cap.py --size 5 --block 120 \
         --caps 20,24 --partners 10 --epochs 8
 
-    # 8-9. north-star variants: pow2 bucketing, then a warm rerun
-    mkdir -p "$OUT/pow2" "$OUT/warm"
+    # 8-10. north-star variants: pow2 bucketing, a warm rerun, and batch
+    # pipelining (double-buffered dispatch — the candidate fix for the
+    # dispatch-gap share of the non-MFU time the trace run quantifies)
+    mkdir -p "$OUT/pow2" "$OUT/warm" "$OUT/pipelined"
     run_bench "$OUT/pow2/config1" MPLC_TPU_SLOT_POW2=1
     run_bench "$OUT/warm/config1"
+    run_bench "$OUT/pipelined/config1" MPLC_TPU_PIPELINE_BATCHES=1
 
     # 10. supplementary estimator methods
     run_bench "$OUT/config3_isreg" BENCH_CONFIG=3 BENCH_METHOD=IS_reg_S

@@ -465,3 +465,49 @@ def test_2d_partner_sharded_hlo_collective_budget(monkeypatch):
     assert len(ar_lines) <= 8, (
         f"{len(ar_lines)} all-reduces in one epoch chunk — the aggregation "
         "psum is no longer fused/hoisted as budgeted")
+
+
+def test_pipeline_batches_matches_default(monkeypatch):
+    """MPLC_TPU_PIPELINE_BATCHES=1 double-buffers coalition batches:
+    batch i+1 is dispatched before batch i's results are fetched, so the
+    device crosses batch boundaries without idling through host-side
+    bookkeeping. Results must be IDENTICAL to the default engine — the
+    same compiled executables run on the same per-coalition rng streams;
+    only the harvest point moves. cap=1 forces multiple batches per
+    evaluate() call so the pending-harvest path really executes."""
+    from helpers import build_scenario
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+    from mplc_tpu.contrib.shapley import powerset_order
+
+    def scenario():
+        return build_scenario(partners_count=5,
+                              amounts_per_partner=[0.1, 0.15, 0.2, 0.25, 0.3],
+                              dataset_name="titanic", epoch_count=2,
+                              gradient_updates_per_pass_count=2, seed=11)
+
+    subsets = powerset_order(5)
+    monkeypatch.delenv("MPLC_TPU_PIPELINE_BATCHES", raising=False)
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
+    ref_vals = CharacteristicEngine(scenario()).evaluate(subsets)
+
+    monkeypatch.setenv("MPLC_TPU_PIPELINE_BATCHES", "1")
+    eng = CharacteristicEngine(scenario())
+    assert eng._pipeline_batches
+    progressed = []
+    eng.progress = lambda done, rem, slots: progressed.append((done, rem, slots))
+    vals = eng.evaluate(subsets)
+
+    np.testing.assert_array_equal(vals, ref_vals)
+    # every coalition was reported exactly once, in order, per slot bucket:
+    # within each bucket the remaining count must walk to exactly 0 with
+    # each step consuming `done` coalitions — a double-harvest or dropped
+    # final flush breaks the walk even when totals happen to match
+    assert sum(d for d, _, _ in progressed) == len(subsets)
+    by_bucket = {}
+    for done, rem, slots in progressed:
+        by_bucket.setdefault(slots, []).append((done, rem))
+    for slots, steps in by_bucket.items():
+        # r_k = r_{k-1} - done_k: each report consumes exactly its group
+        for (_, r_prev), (d, r) in zip(steps, steps[1:]):
+            assert r == r_prev - d, f"bucket {slots} mis-accounted: {steps}"
+        assert steps[-1][1] == 0, f"bucket {slots} never drained: {steps}"
