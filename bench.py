@@ -146,7 +146,7 @@ def _fallback_allowed() -> bool:
             and not os.environ.get("BENCH_IS_FALLBACK_CHILD"))
 
 
-def _replay_cached_tpu_result() -> bool:
+def _replay_cached_tpu_result(repo_root: str | None = None) -> bool:
     """Tunnel down and this is the driver-shaped run (default config):
     prefer re-emitting a real TPU measurement of the SAME workload recorded
     earlier (scripts/r5_queue.sh runs the driver-shaped bench the moment
@@ -164,11 +164,12 @@ def _replay_cached_tpu_result() -> bool:
     # measurement a DIFFERENT workload — same set _spawn_cpu_fallback strips
     for knob in ("BENCH_DTYPE", "MPLC_TPU_COALITIONS_PER_DEVICE",
                  "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
-                 "MPLC_TPU_SLOT_POW2", "MPLC_TPU_SYNTH_SCALE"):
+                 "MPLC_TPU_PIPELINE_BATCHES", "MPLC_TPU_SLOT_POW2",
+                 "MPLC_TPU_SYNTH_SCALE"):
         if os.environ.get(knob):
             return False
     import glob
-    repo = os.path.dirname(os.path.abspath(__file__))
+    repo = repo_root or os.path.dirname(os.path.abspath(__file__))
     best = None
     for path in glob.glob(os.path.join(repo, "perf", "r*", "config1.json")):
         try:
@@ -224,7 +225,8 @@ def _spawn_cpu_fallback() -> int:
     # watchdog, which is deliberately off on CPU.
     for knob in ("BENCH_DTYPE", "MPLC_TPU_COALITIONS_PER_DEVICE",
                  "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
-                 "MPLC_TPU_SLOT_POW2", "MPLC_TPU_SYNTH_SCALE",
+                 "MPLC_TPU_PIPELINE_BATCHES", "MPLC_TPU_SLOT_POW2",
+                 "MPLC_TPU_SYNTH_SCALE",
                  "BENCH_STALL_TIMEOUT", "BENCH_INIT_TIMEOUT"):
         env.pop(knob, None)
     env.update(

@@ -75,12 +75,12 @@ run_bench() {  # run_bench <out-prefix> [ENV=V ...]
     done_step "$prefix.json" || UNFINISHED=$((UNFINISHED + 1))
 }
 
-run_logged() {  # run_logged <logfile> <timeout> <cmd...> — done when log has DONE
+run_local() {  # CPU-side step: no tunnel wait, no busy flag; done when
+               # its log carries the DONE marker
     local log=$1 tmo=$2; shift 2
     if [ -s "$log" ] && grep -q '^QUEUE-STEP-DONE$' "$log"; then
         return 0
     fi
-    wait_for_tunnel
     echo "$(date +%T) running $(basename "$log"): $*"
     timeout "$tmo" "$@" > "$log" 2>&1
     local rc=$?
@@ -90,6 +90,15 @@ run_logged() {  # run_logged <logfile> <timeout> <cmd...> — done when log has 
     return 0
 }
 
+run_logged() {  # tunnel-needing variant: probe first, then share run_local
+    local log=$1
+    if [ -s "$log" ] && grep -q '^QUEUE-STEP-DONE$' "$log"; then
+        return 0
+    fi
+    wait_for_tunnel
+    run_local "$@"
+}
+
 one_pass() {
     # 1. width-scaling curve: block 48 = multiple of lcm(1,2,4,8,16), so no
     #    width pays padding; size 5 is the modal slot count of the north star
@@ -97,11 +106,31 @@ one_pass() {
         python scripts/tune_coalition_cap.py --size 5 --block 48 \
         --caps 1,2,4,8,16 --partners 10 --epochs 8
 
+    # 1b. the measured projection, the moment the curve exists (CPU-side)
+    if grep -q '^QUEUE-STEP-DONE$' "$OUT/width_curve.log" 2>/dev/null; then
+        run_local "$OUT/projection.log" 300 bash -c \
+            "python scripts/project_v5e8.py --curve $OUT/width_curve.log && \
+             python scripts/project_v5e8.py --curve $OUT/width_curve.log --pow2"
+    fi
+
     # 2. driver-shaped north star (exact env shape the driver uses)
     run_bench "$OUT/config1"
 
     # 3. short profiled run: same model/pipelines as the north star
     run_bench "$OUT/trace_run" BENCH_PARTNERS=6 MPLC_TPU_PROFILE_DIR="$OUT/trace"
+
+    # 3b. trace attribution (CPU-side), once the trace exists. A metric
+    # WITHOUT a trace dir means the profiler silently failed — keep the
+    # queue unfinished so the gap is loud, not swallowed.
+    if done_step "$OUT/trace_run.json"; then
+        if [ -d "$OUT/trace" ]; then
+            run_local "$OUT/trace_analysis.log" 600 \
+                python scripts/analyze_trace.py "$OUT/trace"
+        else
+            echo "$(date +%T) trace_run measured but $OUT/trace missing — profiler failed"
+            UNFINISHED=$((UNFINISHED + 1))
+        fi
+    fi
 
     # 4-6. the unmeasured BASELINE configs
     run_bench "$OUT/config3" BENCH_CONFIG=3
