@@ -448,21 +448,28 @@ class CharacteristicEngine:
                 if overlap:
                     # harvest the PREVIOUS batch only after this one is in
                     # the device queue: the device crosses batch boundaries
-                    # with zero idle while the host stores/saves/reports
+                    # with zero idle while the host stores/saves/reports.
+                    # Clear `pending` BEFORE harvesting: if the harvest
+                    # itself raises, the finally below must not record the
+                    # same batch a second time (double-counting the call
+                    # and throughput bookkeeping).
                     if pending is not None:
-                        self._record_group(*pending, per_partner, slot_count)
+                        prev, pending = pending, None
+                        self._record_group(*prev, per_partner, slot_count)
                     pending = (group, fetch, len(subsets) - i)
                 else:
                     self._record_group(group, fetch, len(subsets) - i,
                                        per_partner, slot_count)
-            if pending is not None:
-                self._record_group(*pending, per_partner, slot_count)
-                pending = None
         finally:
             if pending is not None:
-                # a failed prep/dispatch of the NEXT batch must not lose
-                # the finished one: store + autosave it before unwinding
-                self._record_group(*pending, per_partner, slot_count)
+                # the single drain point for the last in-flight batch: on
+                # normal exit this IS its harvest; when prepping/dispatching
+                # the next batch failed, it preserves the finished one
+                # (store + autosave) before unwinding. A harvest that
+                # itself raised cleared `pending` first, so it is never
+                # retried here.
+                prev, pending = pending, None
+                self._record_group(*prev, per_partner, slot_count)
 
     def _record_group(self, group, fetch, remaining, per_partner,
                       slot_count) -> None:

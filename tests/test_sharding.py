@@ -511,3 +511,85 @@ def test_pipeline_batches_matches_default(monkeypatch):
         for (_, r_prev), (d, r) in zip(steps, steps[1:]):
             assert r == r_prev - d, f"bucket {slots} mis-accounted: {steps}"
         assert steps[-1][1] == 0, f"bucket {slots} never drained: {steps}"
+
+
+def test_pipeline_preserves_finished_batch_on_dispatch_failure(monkeypatch):
+    """Pipelined mode's durability contract: when dispatching batch i+1
+    fails, batch i — already computed on device — must still be stored
+    (and autosaved) before the exception unwinds, and must NOT be
+    recorded twice by the finally path."""
+    from helpers import build_scenario
+    from mplc_tpu.contrib.engine import BatchedTrainerPipeline, CharacteristicEngine
+    from itertools import combinations
+
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
+    monkeypatch.setenv("MPLC_TPU_PIPELINE_BATCHES", "1")
+    eng = CharacteristicEngine(build_scenario(
+        partners_count=5, amounts_per_partner=[0.1, 0.15, 0.2, 0.25, 0.3],
+        dataset_name="titanic", epoch_count=2,
+        gradient_updates_per_pass_count=2, seed=11))
+
+    real = BatchedTrainerPipeline.scores_async
+    calls = {"n": 0}
+
+    def failing_second(self, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("simulated dispatch failure")
+        return real(self, *a, **kw)
+
+    monkeypatch.setattr(BatchedTrainerPipeline, "scores_async", failing_second)
+    subsets = list(combinations(range(5), 2))  # 10 size-2 coalitions: 2 batches
+    with pytest.raises(RuntimeError, match="simulated dispatch failure"):
+        eng.evaluate(subsets)
+    # batch 1 (8 coalitions, bucket width 8 at cap=1 on the 8-device mesh)
+    # was harvested exactly once on the way out
+    assert eng.first_charac_fct_calls_count == 8
+    assert len([k for k in eng.charac_fct_values if k]) == 8
+
+
+def test_pipeline_never_double_records_on_harvest_failure(monkeypatch):
+    """A harvest (result fetch) that raises must not be retried by the
+    drain path: retrying would double-count first_charac_fct_calls_count
+    and the throughput accounting (or, with a transiently-failing fetch,
+    record a batch twice). The flaky fetch here raises once, then would
+    succeed — a buggy drain that re-harvests records 10 coalitions
+    instead of 8."""
+    from helpers import build_scenario
+    from mplc_tpu.contrib.engine import BatchedTrainerPipeline, CharacteristicEngine
+    from itertools import combinations
+
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
+    monkeypatch.setenv("MPLC_TPU_PIPELINE_BATCHES", "1")
+    eng = CharacteristicEngine(build_scenario(
+        partners_count=5, amounts_per_partner=[0.1, 0.15, 0.2, 0.25, 0.3],
+        dataset_name="titanic", epoch_count=2,
+        gradient_updates_per_pass_count=2, seed=11))
+
+    real = BatchedTrainerPipeline.scores_async
+    calls = {"n": 0}
+
+    def flaky_second_fetch(self, *a, **kw):
+        calls["n"] += 1
+        fetch = real(self, *a, **kw)
+        if calls["n"] != 2:
+            return fetch
+        state = {"first": True}
+
+        def flaky():
+            if state["first"]:
+                state["first"] = False
+                raise RuntimeError("simulated harvest failure")
+            return fetch()
+
+        return flaky
+
+    monkeypatch.setattr(BatchedTrainerPipeline, "scores_async",
+                        flaky_second_fetch)
+    subsets = list(combinations(range(5), 2))
+    with pytest.raises(RuntimeError, match="simulated harvest failure"):
+        eng.evaluate(subsets)
+    # only batch 1's 8 coalitions recorded; the failed harvest of batch 2
+    # was NOT retried into a double record
+    assert eng.first_charac_fct_calls_count == 8
+    assert len([k for k in eng.charac_fct_values if k]) == 8
