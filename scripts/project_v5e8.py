@@ -45,24 +45,73 @@ def parse_batch_times(log_path):
 
     Returns {slot_count_or_None: [durations]}, plus the width each size ran
     at (all batches of one evaluate() call share one bucket width)."""
-    pat = re.compile(r"\[bench\] timed: \+(\d+) coalitions \(slots=(\w+), "
-                     r"total \d+, \d+ left in call\) t=(\d+)s")
-    rows = []
-    with open(log_path) as f:
-        for line in f:
-            m = pat.search(line)
-            if m:
-                n, slots, t = m.groups()
-                rows.append((int(n),
-                             None if slots == "None" else int(slots), int(t)))
+    rows = parse_timed_rows(log_path)
     if not rows:
         raise SystemExit(f"no timed progress lines in {log_path}")
     times = {}
     prev_t = 0
-    for n, slots, t in rows:
+    for n, slots, _left, t in rows:
         times.setdefault(slots, []).append(t - prev_t)
         prev_t = t
     return times
+
+
+_TIMED_ROW = re.compile(r"\[bench\] timed: \+(\d+) coalitions \(slots=(\w+), "
+                        r"total \d+, (\d+) left in call\) t=(\d+)s")
+
+
+def parse_timed_rows(log_path):
+    """Shared row parser for the '[bench] timed:' progress lines:
+    yields (n_coalitions, slots_or_None, left_in_call, cumulative_t)."""
+    rows = []
+    with open(log_path) as f:
+        for line in f:
+            m = _TIMED_ROW.search(line)
+            if m:
+                n, slots, left, t = m.groups()
+                rows.append((int(n),
+                             None if slots == "None" else int(slots),
+                             int(left), int(t)))
+    return rows
+
+
+def parse_is_log_ratios(log_path, record_cap=16):
+    """Width-scaling ratio points mined from an IS-workload bench log
+    (e.g. perf/r4/config3_attempt1_wedged.log). IS evaluate() calls have
+    varying missing-counts, so their batches ran at bucket widths
+    1/2/4/8/16 across slot sizes — a free width-scaling dataset. The
+    FIRST occurrence of each (slots, width) program pays its residual
+    compile (warm-up only compiles one width per size), so only
+    steady-state repeats count. `record_cap` must be the cap the MINED
+    run used (it determines the recorded bucket widths — independent of
+    the --cap being projected). Returns (w, t(k,w)/t(k, w_max)) ratio
+    points pooled over slot sizes k that have a full-width cell, with
+    w_max = the mined run's single-device full width."""
+    rows = parse_timed_rows(log_path)
+    w_max = bucket_size(record_cap, 1, record_cap)
+    durs = {}
+    prev_t = 0
+    i = 0
+    while i < len(rows):
+        j = i
+        while j < len(rows) and rows[j][2] != 0:
+            j += 1
+        if j >= len(rows):
+            break  # wedge mid-call: drop the incomplete trailing call
+        call_total = sum(r[0] for r in rows[i:j + 1])
+        b = bucket_size(call_total, 1, record_cap)
+        for r in rows[i:j + 1]:
+            durs.setdefault((r[1], b), []).append(r[3] - prev_t)
+            prev_t = r[3]
+        i = j + 1
+    steady = {kw: sum(ds[1:]) / len(ds[1:])
+              for kw, ds in durs.items() if len(ds) > 1 and kw[0] is not None}
+    pts = []
+    for (k, w), t in sorted(steady.items()):
+        t_full = steady.get((k, w_max))
+        if t_full and w != w_max:
+            pts.append((w, t / t_full))
+    return pts, steady
 
 
 def parse_width_curve(curve_path):
@@ -119,6 +168,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--log", default="perf/r4/config1.log")
     ap.add_argument("--curve", default="perf/r5/width_curve.log")
+    ap.add_argument("--islog", default="perf/r4/config3_attempt1_wedged.log",
+                    help="IS-workload log to mine steady-state width ratios "
+                         "from ('' disables)")
+    ap.add_argument("--islog-cap", type=int, default=16,
+                    help="the coalition cap the MINED run used (sets its "
+                         "recorded bucket widths; independent of --cap)")
     ap.add_argument("--ndev", type=int, default=8)
     ap.add_argument("--cap", type=int, default=16)
     ap.add_argument("--partners", type=int, default=10)
@@ -153,6 +208,19 @@ def main():
     else:
         print(f"no usable width curve at {args.curve} (need >= 2 points, "
               f"have {len(pts)}) — bracketing with priors")
+    if args.islog and os.path.exists(args.islog):
+        ratio_pts, _ = parse_is_log_ratios(args.islog, args.islog_cap)
+        w_full = bucket_size(args.islog_cap, 1, args.islog_cap)
+        if len(ratio_pts) >= 2:
+            # fit r(w) = alpha*w + beta over the pooled ratio points,
+            # anchored by construction at r(w_full) ~ 1
+            a, c = fit_affine(ratio_pts + [(w_full, 1.0)])
+            models["measured-r4-islog"] = \
+                lambda w, a=a, c=c: max(a * w + c, 1e-6)
+            print(f"IS-log width ratios from {args.islog} "
+                  f"(steady-state batches only): r(w) = {a:.4f}*w + {c:.3f}")
+            print(f"  points (w, t/t{w_full}): "
+                  + ", ".join(f"({w}, {r:.3f})" for w, r in ratio_pts))
     models["linear(optimistic)"] = lambda w: w / 16.0
     models["flat(pessimistic)"] = lambda w: 1.0
 
