@@ -1,0 +1,81 @@
+"""Pins for the batch-granular v5e-8 projection pipeline.
+
+The 290 s north-star projection (perf/r5/PROJECTION_r4data.md) rests on
+scripts/project_v5e8.py's log mining: call-boundary reconstruction,
+bucket-width attribution, first-occurrence (residual-compile) exclusion,
+and the affine width fit. These tests pin that analysis against the
+committed r4 artifacts so a parser regression cannot silently move the
+headline number, and pin the schedule model against the engine's real
+_bucket_size.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT / "scripts"))
+
+import project_v5e8 as proj  # noqa: E402
+
+R4_SWEEP = ROOT / "perf" / "r4" / "config1.log"
+R4_ISLOG = ROOT / "perf" / "r4" / "config3_attempt1_wedged.log"
+
+
+def test_bucket_size_matches_engine():
+    from mplc_tpu.contrib.engine import _bucket_size
+    for n in (1, 2, 5, 10, 16, 45, 120, 128, 210, 252, 1023):
+        for n_dev in (1, 8):
+            for cap in (1, 8, 16):
+                assert proj.bucket_size(n, n_dev, cap) == _bucket_size(n, n_dev, cap)
+
+
+@pytest.mark.skipif(not R4_SWEEP.exists(), reason="r4 artifact absent")
+def test_sweep_log_batch_times():
+    times = proj.parse_batch_times(str(R4_SWEEP))
+    # the full 1023-coalition sweep: every slot size present, known medians
+    assert set(times) == {None} | set(range(2, 11))
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    assert med(times[5]) == 31      # modal size, 16 batches
+    assert med(times[9]) == 55      # width-16 size-9 batch
+    assert med(times[10]) == 2      # the width-1 size-10 batch
+
+
+@pytest.mark.skipif(not R4_ISLOG.exists(), reason="r4 artifact absent")
+def test_is_log_mining_pins_the_measured_curve():
+    pts, steady = proj.parse_is_log_ratios(str(R4_ISLOG), record_cap=16)
+    # known steady-state cells (s/batch) from the wedged IS run
+    assert steady[(3, 16)] == pytest.approx(18.43, abs=0.1)
+    assert steady[(7, 8)] == pytest.approx(21.0, abs=0.1)
+    assert steady[(2, 2)] == pytest.approx(1.5, abs=0.1)
+    # 8 pooled ratio points at widths 2/4/8, all well below flat scaling
+    assert len(pts) == 8
+    assert {w for w, _ in pts} == {2, 4, 8}
+    for w, r in pts:
+        assert r < 0.6, (w, r)            # refutes the latency-bound prior
+        assert r == pytest.approx(w / 16.0, abs=0.06)  # ~linear in width
+    a, c = proj.fit_affine(pts + [(16, 1.0)])
+    assert 0.055 <= a <= 0.072            # slope ~1/16
+    assert abs(c) < 0.1                   # near-zero per-batch constant
+
+
+def test_schedule_reproduces_engine_bucket_plan():
+    # the exact 8-device plan PROJECTION_r4data.md's number is built on
+    assert proj.schedule(10, 8, 16, pow2=False) == [
+        (1, 16, 1), (2, 64, 1), (3, 128, 1), (4, 128, 2), (5, 128, 2),
+        (6, 128, 2), (7, 128, 1), (8, 64, 1), (9, 16, 1), (10, 8, 1)]
+    assert proj.schedule(10, 8, 16, pow2=True) == [
+        (1, 16, 1), (2, 64, 1), (4, 128, 3), (8, 128, 5), (10, 16, 1)]
+
+
+@pytest.mark.skipif(not R4_ISLOG.exists(), reason="r4 artifact absent")
+def test_truncated_log_drops_incomplete_trailing_call(tmp_path):
+    lines = R4_ISLOG.read_text().splitlines()
+    cut = max(i for i, ln in enumerate(lines)
+              if "left in call" in ln and " 0 left" not in ln)
+    trunc = tmp_path / "trunc.log"
+    trunc.write_text("\n".join(lines[:cut + 1]))
+    pts, steady = proj.parse_is_log_ratios(str(trunc), record_cap=16)
+    assert pts                      # still mines the complete calls
+    assert (3, 16) in steady        # early complete calls survive the cut
