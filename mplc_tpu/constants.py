@@ -56,5 +56,10 @@ SUPPORTED_DATASETS_NAMES = [MNIST, CIFAR10, TITANIC, ESC50, IMDB]
 # larger requests are chunked so HBM stays bounded.
 MAX_COALITIONS_PER_DEVICE_BATCH = 16
 # Chunk size (samples) for validation/test-set evaluation inside jit, to bound
-# the [coalitions x partners x samples] activation footprint.
-EVAL_CHUNK_SIZE = 2048
+# the [coalitions x partners x samples] activation footprint. Env-overridable
+# (MPLC_TPU_EVAL_CHUNK) so the coalition-cap crash bisect can halve the eval
+# window to test whether wide-batch worker crashes are program-shape-bound
+# (perf/r4/tune_cap32.log; VERDICT r4 weak #3).
+import os as _os
+
+EVAL_CHUNK_SIZE = int(_os.environ.get("MPLC_TPU_EVAL_CHUNK", "2048"))

@@ -142,6 +142,19 @@ one_pass() {
         python scripts/tune_coalition_cap.py --size 5 --block 120 \
         --caps 20,24 --partners 10 --epochs 8
 
+    # 7b. if the bisect crashed, test the program-shape hypothesis before
+    # calling the cap=32 crash axon-specific: same width with a halved
+    # eval-chunk window (the other large activation in the program). No
+    # donation toggle exists to rule out — the engine never uses
+    # donate_argnums.
+    if [ -s "$OUT/cap_bisect.log" ] && \
+       ! grep -q '^QUEUE-STEP-DONE$' "$OUT/cap_bisect.log"; then
+        run_logged "$OUT/cap_bisect_halfeval.log" 3600 \
+            env MPLC_TPU_EVAL_CHUNK=1024 \
+            python scripts/tune_coalition_cap.py --size 5 --block 96 \
+            --caps 24,32 --partners 10 --epochs 8
+    fi
+
     # 8-10. north-star variants: pow2 bucketing, a warm rerun, and batch
     # pipelining (double-buffered dispatch — the candidate fix for the
     # dispatch-gap share of the non-MFU time the trace run quantifies)
