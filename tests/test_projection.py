@@ -79,3 +79,33 @@ def test_truncated_log_drops_incomplete_trailing_call(tmp_path):
     pts, steady = proj.parse_is_log_ratios(str(trunc), record_cap=16)
     assert pts                      # still mines the complete calls
     assert (3, 16) in steady        # early complete calls survive the cut
+
+
+@pytest.mark.skipif(not (R4_SWEEP.exists() and R4_ISLOG.exists()),
+                    reason="r4 artifacts absent")
+def test_headline_projection_number_is_stable():
+    """End-to-end pin on the committed headline: the measured-r(w)
+    batch-granular projection of the 10-partner sweep on 8 devices must
+    stay in the documented band (PROJECTION_r4data.md: 290 s, bar 300 s).
+    Any parser/model/schedule drift that moves the claim fails here."""
+    times = proj.parse_batch_times(str(R4_SWEEP))
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    t16 = {(1 if s is None else s): float(median(ds))
+           for s, ds in times.items()}
+    pts, _ = proj.parse_is_log_ratios(str(R4_ISLOG), record_cap=16)
+    a, c = proj.fit_affine(pts + [(16, 1.0)])
+    r = lambda w: max(a * w + c, 1e-6)  # noqa: E731
+
+    total = 0.0
+    for slot_w, b, nb in proj.schedule(10, 8, 16, pow2=False):
+        per_dev_w = b / 8
+        if slot_w == 10:
+            base = t16[10] * r(16) / r(1)   # measured at width 1
+        else:
+            base = t16[slot_w]
+        total += nb * base * r(per_dev_w) / r(16)
+    assert 280 <= total <= 300, total
