@@ -162,10 +162,12 @@ def _replay_cached_tpu_result(repo_root: str | None = None) -> bool:
         return False
     # any workload-shaping knob off its default makes the cached full-scale
     # measurement a DIFFERENT workload — same set _spawn_cpu_fallback strips
+    # (MPLC_TPU_EVAL_CHUNK changes the compiled eval program and the
+    # memory-derived batch cap, so it shapes the workload too)
     for knob in ("BENCH_DTYPE", "MPLC_TPU_COALITIONS_PER_DEVICE",
-                 "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
-                 "MPLC_TPU_PIPELINE_BATCHES", "MPLC_TPU_SLOT_POW2",
-                 "MPLC_TPU_SYNTH_SCALE"):
+                 "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_NO_SLOTS",
+                 "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
+                 "MPLC_TPU_SLOT_POW2", "MPLC_TPU_SYNTH_SCALE"):
         if os.environ.get(knob):
             return False
     import glob
@@ -198,6 +200,13 @@ def _replay_cached_tpu_result(repo_root: str | None = None) -> bool:
     print(json.dumps({"metric": rec["metric"] + "_cached",
                       "value": rec["value"], "unit": rec["unit"],
                       "vs_baseline": rec.get("vs_baseline")}))
+    # the telemetry sidecar makes the provenance machine-readable: this
+    # number was REPLAYED, not measured by this process
+    _write_telemetry({"source": "replayed_cache",
+                      "replayed_from": os.path.relpath(path, repo),
+                      "replayed_mtime": mtime,
+                      "metric": rec["metric"] + "_cached",
+                      "value": rec["value"]}, repo_root=repo)
     return True
 
 
@@ -224,10 +233,13 @@ def _spawn_cpu_fallback() -> int:
     # and a tight accelerator stall/init timeout would re-arm the child's
     # watchdog, which is deliberately off on CPU.
     for knob in ("BENCH_DTYPE", "MPLC_TPU_COALITIONS_PER_DEVICE",
-                 "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
-                 "MPLC_TPU_PIPELINE_BATCHES", "MPLC_TPU_SLOT_POW2",
-                 "MPLC_TPU_SYNTH_SCALE",
-                 "BENCH_STALL_TIMEOUT", "BENCH_INIT_TIMEOUT"):
+                 "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_NO_SLOTS",
+                 "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
+                 "MPLC_TPU_SLOT_POW2", "MPLC_TPU_SYNTH_SCALE",
+                 "BENCH_STALL_TIMEOUT", "BENCH_INIT_TIMEOUT",
+                 # the child writes its own _cpu_fallback-suffixed sidecar;
+                 # inheriting an explicit path would race the parent's file
+                 "BENCH_TELEMETRY_FILE", "MPLC_TPU_TRACE_FILE"):
         env.pop(knob, None)
     env.update(
         # A clean PYTHONPATH drops the ambient accelerator registration,
@@ -422,6 +434,46 @@ def _throughput_note(engine, elapsed):
     print(line, file=sys.stderr, flush=True)
 
 
+def _telemetry_path(repo_root: str | None = None) -> str | None:
+    """Sidecar destination: BENCH_TELEMETRY_FILE wins (empty string
+    disables); default is perf/telemetry_config<N><suffix>.json next to
+    the driver's perf JSONs."""
+    if "BENCH_TELEMETRY_FILE" in os.environ:
+        return os.environ["BENCH_TELEMETRY_FILE"] or None
+    repo = repo_root or os.path.dirname(os.path.abspath(__file__))
+    cfg = os.environ.get("BENCH_CONFIG", "1")
+    suffix = os.environ.get("BENCH_METRIC_SUFFIX", "")
+    return os.path.join(repo, "perf", f"telemetry_config{cfg}{suffix}.json")
+
+
+def _write_telemetry(payload: dict, repo_root: str | None = None) -> None:
+    """Write the per-run telemetry sidecar (sweep report + provenance —
+    `source` records whether the emitted number was fresh, replayed from
+    cache, or a CPU fallback). Never fatal: telemetry must not take down a
+    bench that measured successfully."""
+    if _watchdog_fired.is_set():
+        # same rule as _emit: once the watchdog declared the run dead, a
+        # recovered main thread must not write a 'fresh' sidecar for it
+        # (the fallback child owns the telemetry now)
+        return
+    try:
+        path = _telemetry_path(repo_root)
+        if path is None:
+            return
+        from mplc_tpu.obs.report import write_report
+        payload = dict(payload)
+        payload.setdefault("source",
+                           "cpu_fallback"
+                           if os.environ.get("BENCH_IS_FALLBACK_CHILD")
+                           else "fresh")
+        write_report(path, payload)
+        print(f"[bench] telemetry sidecar: {path}", file=sys.stderr,
+              flush=True)
+    except Exception as e:
+        print(f"[bench] telemetry sidecar failed: {e}", file=sys.stderr,
+              flush=True)
+
+
 def _emit(metric, elapsed, baseline):
     if _watchdog_fired.is_set():
         # The stall watchdog already took over (its fallback child owns
@@ -456,9 +508,12 @@ def bench_exact_shapley(epochs, dtype):
     timed = _attach_progress(_fresh_engine(sc, warm), "timed")
     t0 = time.perf_counter()
     # a real device trace of the timed sweep when MPLC_TPU_PROFILE_DIR is
-    # set (utils.profile_trace is a no-op otherwise)
+    # set (utils.profile_trace is a no-op otherwise); the span collector is
+    # always on (in-memory, no device syncs) and feeds the sweep report —
+    # any compile time it shows is a RESIDUAL compile the warm-up missed
+    from mplc_tpu.obs import trace as obs_trace
     from mplc_tpu.utils import profile_trace
-    with profile_trace():
+    with profile_trace(), obs_trace.collect() as tele:
         accs = timed.evaluate(coalitions)
     elapsed = time.perf_counter() - t0
     assert timed.first_charac_fct_calls_count == B
@@ -475,8 +530,13 @@ def bench_exact_shapley(epochs, dtype):
           f"v5e-8 (8-way coal sharding, zero-communication axis => ~linear): "
           f"{elapsed / 8:.1f} s", file=sys.stderr)
     _throughput_note(timed, elapsed)
-    _emit(f"exact_shapley_{dataset}_{n_partners}partners_{epochs}epochs_wallclock",
-          elapsed, _baseline_seconds(dataset, epochs, B))
+    metric = f"exact_shapley_{dataset}_{n_partners}partners_{epochs}epochs_wallclock"
+    from mplc_tpu.obs.report import format_report, sweep_report
+    rep = sweep_report(tele)
+    print(format_report(rep), file=sys.stderr, flush=True)
+    _write_telemetry({"metric": metric, "wallclock_s": elapsed,
+                      "devices": _ndev(), "report": rep})
+    _emit(metric, elapsed, _baseline_seconds(dataset, epochs, B))
 
 
 def _bench_method(dataset_name, n_partners, method, epochs, dtype,
@@ -504,9 +564,10 @@ def _bench_method(dataset_name, n_partners, method, epochs, dtype,
             engine_time["s"] += time.perf_counter() - te
 
     timed.evaluate = _timed_eval
+    from mplc_tpu.obs import trace as obs_trace
     from mplc_tpu.utils import profile_trace
     t0 = time.perf_counter()
-    with profile_trace():
+    with profile_trace(), obs_trace.collect() as tele:
         contrib = Contributivity(sc)
         contrib.compute_contributivity(method)
         for m in extra_methods:
@@ -526,8 +587,13 @@ def _bench_method(dataset_name, n_partners, method, epochs, dtype,
           f"of wall-clock)", file=sys.stderr)
     _throughput_note(timed, elapsed)
     tag = method.lower().replace(" ", "_")
-    _emit(f"{tag}_{dataset_name}_{n_partners}partners_{epochs}epochs_wallclock",
-          elapsed, _baseline_seconds(dataset_name, epochs, calls))
+    metric = f"{tag}_{dataset_name}_{n_partners}partners_{epochs}epochs_wallclock"
+    from mplc_tpu.obs.report import format_report, sweep_report
+    rep = sweep_report(tele)
+    print(format_report(rep), file=sys.stderr, flush=True)
+    _write_telemetry({"metric": metric, "wallclock_s": elapsed,
+                      "devices": _ndev(), "report": rep})
+    _emit(metric, elapsed, _baseline_seconds(dataset_name, epochs, calls))
 
 
 def _ndev():

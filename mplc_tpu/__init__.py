@@ -10,5 +10,6 @@ call `mplc_tpu.utils.init_logger()` explicitly if desired.
 """
 
 from . import constants  # noqa: F401
+from . import obs  # noqa: F401  (stdlib-only; no jax import at module load)
 
 __version__ = "0.1.0"

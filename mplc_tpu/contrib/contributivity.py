@@ -47,6 +47,7 @@ import jax.numpy as jnp
 
 from .. import constants
 from ..mpl.engine import MplTrainer, TrainConfig
+from ..obs import trace as obs_trace
 from .engine import CharacteristicEngine
 from .sampling import (WithoutReplacementRanks, make_importance_sampler,
                        randbelow, unrank_combination)
@@ -154,13 +155,26 @@ class Contributivity:
         out += f"Normalized contributivity scores: {np.round(self.normalized_scores, 3)}\n"
         return out
 
+    def _method_span(self, method: str) -> obs_trace.Span:
+        """The estimator's timing span: `computation_time_sec` is derived
+        from it in `_finish` (single source of truth — the span IS the
+        timer), and ending it emits one `contributivity` trace record per
+        method run when telemetry is on."""
+        return obs_trace.start_span("contributivity", method=method)
+
     def _finish(self, name, scores, std, t0):
         self.name = name
         self.contributivity_scores = np.asarray(scores, float)
         self.scores_std = np.asarray(std, float)
         total = np.sum(self.contributivity_scores)
         self.normalized_scores = self.contributivity_scores / (total if total else 1.0)
-        self.computation_time_sec = time.perf_counter() - t0
+        if isinstance(t0, obs_trace.Span):
+            t0.attrs["method"] = name  # final display name, not the seed label
+            self.computation_time_sec = t0.end().duration
+        else:
+            # raw perf_counter() origin (external callers/tests): same
+            # wall-clock semantics, no trace record
+            self.computation_time_sec = time.perf_counter() - t0
 
     @property
     def _n(self):
@@ -175,7 +189,7 @@ class Contributivity:
     # ------------------------------------------------------------------
 
     def compute_SV(self):
-        t0 = time.perf_counter()
+        t0 = self._method_span("Shapley")
         logger.info("# Launching computation of Shapley Value of all partners")
         n = self._n
         coalitions = powerset_order(n)
@@ -188,7 +202,7 @@ class Contributivity:
     # ------------------------------------------------------------------
 
     def compute_independent_scores(self):
-        t0 = time.perf_counter()
+        t0 = self._method_span("Independent scores raw")
         logger.info("# Launching computation of perf. scores of models trained "
                     "independently on each partner")
         n = self._n
@@ -200,10 +214,10 @@ class Contributivity:
     # ------------------------------------------------------------------
 
     def _tmc(self, sv_accuracy, alpha, truncation, interpolate, perm_batch=16):
-        t0 = time.perf_counter()
+        name = "ITMCS" if interpolate else "TMC Shapley"
+        t0 = self._method_span(name)
         n = self._n
         v_all = float(self.engine.evaluate([tuple(range(n))])[0])
-        name = "ITMCS" if interpolate else "TMC Shapley"
         if n == 1:
             self._finish(name, np.array([v_all]), np.array([0.0]), t0)
             return
@@ -310,7 +324,7 @@ class Contributivity:
 
     def IS_lin(self, sv_accuracy=0.01, alpha=0.95):
         """Linear-interpolation importance sampling (reference :326-439)."""
-        t0 = time.perf_counter()
+        t0 = self._method_span("IS_lin Shapley")
         logger.info("# Launching IS_lin Shapley")
         n = self._n
         v_all = float(self.engine.evaluate([tuple(range(n))])[0])
@@ -341,10 +355,13 @@ class Contributivity:
     def IS_reg(self, sv_accuracy=0.01, alpha=0.95):
         """Regression importance sampling (reference :443-569). Falls back to
         exact SV for n < 4 like the reference."""
-        t0 = time.perf_counter()
+        t0 = self._method_span("IS_reg Shapley")
         logger.info("# Launching IS_reg Shapley")
         n = self._n
         if n < 4:
+            # compute_SV times itself through its own span; drop this one
+            # so the nesting stack stays clean on the early exit
+            t0.cancel()
             self.compute_SV()
             self.name = "IS_reg Shapley values"
             return
@@ -389,7 +406,7 @@ class Contributivity:
 
     def AIS_Kriging(self, sv_accuracy=0.01, alpha=0.95, update=50):
         """Adaptive Kriging importance sampling (reference :573-723)."""
-        t0 = time.perf_counter()
+        t0 = self._method_span("AIS Shapley")
         logger.info("# Launching AIS Kriging Shapley")
         n = self._n
         # seed evaluations: full set, singletons, pairs + their complements
@@ -464,7 +481,7 @@ class Contributivity:
     def Stratified_MC(self, sv_accuracy=0.01, alpha=0.95):
         """Stratified MC Shapley (reference :727-819): per-partner strata by
         coalition size, adaptive allocation toward high-variance strata."""
-        t0 = time.perf_counter()
+        t0 = self._method_span("Stratified MC Shapley")
         logger.info("# Launching Stratified MC Shapley")
         N = self._n
         v_all = float(self.engine.evaluate([tuple(range(N))])[0])
@@ -536,7 +553,7 @@ class Contributivity:
 
     def without_replacment_SMC(self, sv_accuracy=0.01, alpha=0.95):
         """Without-replacement stratified MC (reference :823-938)."""
-        t0 = time.perf_counter()
+        t0 = self._method_span("WR_SMC Shapley")
         logger.info("# Launching WR_SMC Shapley")
         N = self._n
         v_all = float(self.engine.evaluate([tuple(range(N))])[0])
@@ -636,15 +653,11 @@ class Contributivity:
         return rel[first:last, :]
 
     def _sbs(self, importance_fn, name):
-        t0 = time.perf_counter()
+        sp = self._method_span(name)
         rel = self.compute_relative_perf_matrix()
         rounds = rel.shape[0]
         scores = importance_fn(rounds) @ np.nan_to_num(rel)
-        self.name = name
-        self.contributivity_scores = np.asarray(scores, float)
-        total = np.sum(self.contributivity_scores)
-        self.normalized_scores = self.contributivity_scores / (total if total else 1.0)
-        self.computation_time_sec = time.perf_counter() - t0
+        self._finish(name, scores, np.zeros(self._n), sp)
 
     def federated_SBS_linear(self):
         logger.info("# Federated SBS linear")
@@ -657,15 +670,12 @@ class Contributivity:
                   "Federated step by step quadratic scores")
 
     def federated_SBS_constant(self):
-        t0 = time.perf_counter()
+        sp = self._method_span("Federated step by step constant scores")
         logger.info("# Federated SBS constant")
         rel = self.compute_relative_perf_matrix()
         scores = np.nanmean(rel, axis=0)
-        self.name = "Federated step by step constant scores"
-        self.contributivity_scores = np.asarray(scores, float)
-        total = np.sum(self.contributivity_scores)
-        self.normalized_scores = self.contributivity_scores / (total if total else 1.0)
-        self.computation_time_sec = time.perf_counter() - t0
+        self._finish("Federated step by step constant scores", scores,
+                     np.zeros(self._n), sp)
 
     # ------------------------------------------------------------------
     # 13. LFlip
@@ -674,7 +684,7 @@ class Contributivity:
     def flip_label(self):
         """Train MplLabelFlip; score = exp(-||theta_i - I||_F)
         (reference contributivity.py:1117-1132)."""
-        t0 = time.perf_counter()
+        t0 = self._method_span("Label Flip")
         from ..mpl.approaches import MplLabelFlip
         mpl = MplLabelFlip(self.scenario)
         mpl.fit()
@@ -696,7 +706,7 @@ class Contributivity:
         upstream constructor call is broken — this is the documented intent).
         Driven through the coalition-maskable trainer one epoch at a time:
         the selection mask is exactly a coalition mask."""
-        t0 = time.perf_counter()
+        t0 = self._method_span("PVRL")
         logger.info("# Launching PVRL")
         sc = self.scenario
         n = self._n
@@ -714,11 +724,13 @@ class Contributivity:
             # no per-minibatch val history needed
             record_val_history=False,
         )
-        trainer = MplTrainer(sc.dataset.model, cfg)
+        trainer = MplTrainer.get(sc.dataset.model, cfg)
         rng = jax.random.PRNGKey(getattr(sc, "seed", 0) + 99)
         state = trainer.init_state(rng, n)
-        run = jax.jit(trainer.epoch_chunk, static_argnames=("n_epochs",))
-        ev = jax.jit(trainer.evaluate)
+        # the trainer's pinned jits: dedupes compiles across PVRL runs on
+        # one (model, cfg) and routes them through the compile telemetry
+        run = trainer.jit_epoch_chunk
+        ev = trainer.jit_evaluate
 
         w = np.zeros(n)
         values = 1.0 / (1.0 + np.exp(-w))

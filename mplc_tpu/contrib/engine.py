@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 
 import numpy as np
 
@@ -36,6 +37,8 @@ import jax
 import jax.numpy as jnp
 
 from .. import constants
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..data.partition import StackedPartners, stack_eval_set
 from ..mpl.engine import EvalSet, MplTrainer, TrainConfig
 from ..parallel.mesh import coalition_sharding, make_2d_mesh
@@ -319,7 +322,9 @@ class CharacteristicEngine:
         self._epoch_samples_single = sizes_np
         # When set, the memo cache is persisted after EVERY device batch, so
         # a crash mid-sweep loses at most one batch of trained coalitions
-        # (the reference loses everything — it checkpoints nothing).
+        # (the reference loses everything — it checkpoints nothing). Under
+        # MPLC_TPU_PIPELINE_BATCHES a second batch can be in flight when a
+        # hard kill lands, so the loss bound there is up to TWO batches.
         self.autosave_path = None
         # Optional callable(done_in_group, remaining_in_call, slot_count)
         # invoked after every completed device batch — long sweeps (and the
@@ -420,31 +425,35 @@ class CharacteristicEngine:
                        if pipe is self.single_pipe
                        else self._epoch_samples_multi)
 
-        pending = None  # (group, fetch-thunk, remaining-after) in flight
+        pending = None  # (group, fetch-thunk, remaining-after, meta) in flight
         try:
             i = 0
             while i < len(subsets):
                 group = subsets[i:i + b]
                 i += len(group)
-                padded = list(group) + [group[0]] * (b - len(group))
-                if slot_count is not None:
-                    coal = np.full((b, slot_count), -1, np.int32)
-                    for j, s in enumerate(padded):
-                        coal[j, :len(s)] = sorted(s)
-                else:
-                    coal = np.zeros((b, self.partners_count), np.float32)
-                    for j, s in enumerate(padded):
-                        coal[j, list(s)] = 1.0
-                rngs = jnp.stack([self._coalition_rng(s) for s in padded])
-                coal = jnp.asarray(coal)
-                if getattr(pipe, "batch_sharding", None) is not None:
-                    coal = jax.device_put(coal, pipe.batch_sharding)
-                    rngs = jax.device_put(rngs, pipe.rng_sharding)
-                elif self._sharding is not None:
-                    coal = jax.device_put(coal, self._sharding.batch_sharding)
-                    rngs = jax.device_put(rngs, self._sharding.batch_sharding)
-                fetch = pipe.scores_async(coal, rngs, self.stacked, self.val,
-                                          self.test, self._coalition_rng(()))
+                attrs = {"width": b, "slot_count": slot_count,
+                         "coalitions": len(group), "padding": b - len(group)}
+                meta = {**attrs, "t0": time.perf_counter()}
+                with obs_trace.span("engine.dispatch", **attrs):
+                    padded = list(group) + [group[0]] * (b - len(group))
+                    if slot_count is not None:
+                        coal = np.full((b, slot_count), -1, np.int32)
+                        for j, s in enumerate(padded):
+                            coal[j, :len(s)] = sorted(s)
+                    else:
+                        coal = np.zeros((b, self.partners_count), np.float32)
+                        for j, s in enumerate(padded):
+                            coal[j, list(s)] = 1.0
+                    rngs = jnp.stack([self._coalition_rng(s) for s in padded])
+                    coal = jnp.asarray(coal)
+                    if getattr(pipe, "batch_sharding", None) is not None:
+                        coal = jax.device_put(coal, pipe.batch_sharding)
+                        rngs = jax.device_put(rngs, pipe.rng_sharding)
+                    elif self._sharding is not None:
+                        coal = jax.device_put(coal, self._sharding.batch_sharding)
+                        rngs = jax.device_put(rngs, self._sharding.batch_sharding)
+                    fetch = pipe.scores_async(coal, rngs, self.stacked, self.val,
+                                              self.test, self._coalition_rng(()))
                 if overlap:
                     # harvest the PREVIOUS batch only after this one is in
                     # the device queue: the device crosses batch boundaries
@@ -456,9 +465,9 @@ class CharacteristicEngine:
                     if pending is not None:
                         prev, pending = pending, None
                         self._record_group(*prev, per_partner, slot_count)
-                    pending = (group, fetch, len(subsets) - i)
+                    pending = (group, fetch, len(subsets) - i, meta)
                 else:
-                    self._record_group(group, fetch, len(subsets) - i,
+                    self._record_group(group, fetch, len(subsets) - i, meta,
                                        per_partner, slot_count)
         finally:
             if pending is not None:
@@ -471,17 +480,35 @@ class CharacteristicEngine:
                 prev, pending = pending, None
                 self._record_group(*prev, per_partner, slot_count)
 
-    def _record_group(self, group, fetch, remaining, per_partner,
+    def _record_group(self, group, fetch, remaining, meta, per_partner,
                       slot_count) -> None:
         """Per-batch bookkeeping shared by _run_batch and
         _run_singles_sliced: fetch results, memoize scores, account
-        epochs/samples, autosave, report progress."""
-        accs, epochs = fetch()
+        epochs/samples, telemetry, autosave, report progress."""
+        with obs_trace.span("engine.harvest", width=meta["width"],
+                            slot_count=slot_count,
+                            coalitions=meta["coalitions"]):
+            accs, epochs = fetch()
+        batch_epochs = 0
         for s, acc, ep in zip(group, accs[:len(group)], epochs[:len(group)]):
             self._store(s, float(acc))
-            self.epochs_trained += int(ep)
+            batch_epochs += int(ep)
             self.samples_trained += int(ep) * int(
                 sum(int(per_partner[i]) for i in s))
+        self.epochs_trained += batch_epochs
+        # per-batch telemetry: dur spans dispatch-start -> harvest-end (under
+        # batch pipelining consecutive batches overlap, so these durations
+        # sum to more than wall-clock — a utilization view). All host-side;
+        # the only device sync is the harvest fetch that already happened.
+        obs_trace.event(
+            "engine.batch", dur=time.perf_counter() - meta["t0"],
+            width=meta["width"], slot_count=slot_count,
+            coalitions=meta["coalitions"], padding=meta["padding"],
+            epochs=batch_epochs)
+        obs_metrics.counter("engine.epochs_trained").inc(batch_epochs)
+        obs_metrics.histogram("engine.pad_waste_fraction").observe(
+            meta["padding"] / meta["width"])
+        obs_metrics.sample_device_memory()
         if self.autosave_path is not None:
             self.save_cache(self.autosave_path)
         if self.progress is not None:
@@ -514,19 +541,23 @@ class CharacteristicEngine:
         while i < len(singles):
             group = singles[i:i + b]
             i += len(group)
-            padded = list(group) + [group[0]] * (b - len(group))
-            ids = np.asarray([s[0] for s in padded], np.int32)
-            sliced = StackedPartners(
-                x=jax.device_put(jnp.take(self.stacked.x, ids, axis=0), rep_sh),
-                y=jax.device_put(jnp.take(self.stacked.y, ids, axis=0), rep_sh),
-                mask=jax.device_put(jnp.take(self.stacked.mask, ids, axis=0), rep_sh),
-                sizes=jax.device_put(jnp.take(self.stacked.sizes, ids, axis=0), rep_sh))
-            coal = jax.device_put(jnp.eye(b, dtype=jnp.float32), coal_sh)
-            rngs = jax.device_put(
-                jnp.stack([self._coalition_rng(s) for s in padded]), coal_sh)
-            fetch = pipe.scores_async(coal, rngs, sliced, self.val, self.test,
-                                      self._coalition_rng(()))
-            self._record_group(group, fetch, len(singles) - i,
+            attrs = {"width": b, "slot_count": None,
+                     "coalitions": len(group), "padding": b - len(group)}
+            meta = {**attrs, "t0": time.perf_counter()}
+            with obs_trace.span("engine.dispatch", **attrs):
+                padded = list(group) + [group[0]] * (b - len(group))
+                ids = np.asarray([s[0] for s in padded], np.int32)
+                sliced = StackedPartners(
+                    x=jax.device_put(jnp.take(self.stacked.x, ids, axis=0), rep_sh),
+                    y=jax.device_put(jnp.take(self.stacked.y, ids, axis=0), rep_sh),
+                    mask=jax.device_put(jnp.take(self.stacked.mask, ids, axis=0), rep_sh),
+                    sizes=jax.device_put(jnp.take(self.stacked.sizes, ids, axis=0), rep_sh))
+                coal = jax.device_put(jnp.eye(b, dtype=jnp.float32), coal_sh)
+                rngs = jax.device_put(
+                    jnp.stack([self._coalition_rng(s) for s in padded]), coal_sh)
+                fetch = pipe.scores_async(coal, rngs, sliced, self.val, self.test,
+                                          self._coalition_rng(()))
+            self._record_group(group, fetch, len(singles) - i, meta,
                                self._epoch_samples_single, None)
 
     def _store(self, subset: tuple, value: float) -> None:
@@ -552,24 +583,31 @@ class CharacteristicEngine:
         """Batched memoized v(S) for a list of subsets (any iterables of
         partner indices). Returns values in input order."""
         keys = [tuple(sorted(int(i) for i in s)) for s in subsets]
-        missing = [k for k in dict.fromkeys(keys)  # stable-unique
-                   if k not in self.charac_fct_values]
-        singles = [k for k in missing if len(k) == 1]
-        multis = [k for k in missing if len(k) > 1]
-        if singles:
-            if self._pipe2d is not None:
-                self._run_singles_sliced(singles)
-            else:
-                self._run_batch(singles, self.single_pipe)
-        if multis:
-            if self._pipe2d is not None:
-                self._run_batch(multis, self._pipe2d)
-            elif self._use_slots:
-                for slot_count, group in self._slot_buckets(multis):
-                    self._run_batch(group, self._slot_pipe(slot_count),
-                                    slot_count=slot_count)
-            else:
-                self._run_batch(multis, self.multi_pipe)
+        unique = dict.fromkeys(keys)  # stable-unique
+        missing = [k for k in unique if k not in self.charac_fct_values]
+        # memo accounting over unique keys: intra-call duplicates don't
+        # inflate the hit rate
+        obs_metrics.counter("engine.memo_hits").inc(len(unique) - len(missing))
+        obs_metrics.counter("engine.memo_misses").inc(len(missing))
+        obs_metrics.counter("engine.coalitions_evaluated").inc(len(missing))
+        with obs_trace.span("engine.evaluate", requested=len(unique),
+                            missing=len(missing)):
+            singles = [k for k in missing if len(k) == 1]
+            multis = [k for k in missing if len(k) > 1]
+            if singles:
+                if self._pipe2d is not None:
+                    self._run_singles_sliced(singles)
+                else:
+                    self._run_batch(singles, self.single_pipe)
+            if multis:
+                if self._pipe2d is not None:
+                    self._run_batch(multis, self._pipe2d)
+                elif self._use_slots:
+                    for slot_count, group in self._slot_buckets(multis):
+                        self._run_batch(group, self._slot_pipe(slot_count),
+                                        slot_count=slot_count)
+                else:
+                    self._run_batch(multis, self.multi_pipe)
         return np.array([self.charac_fct_values[k] for k in keys])
 
     def _slot_buckets(self, multis: list[tuple]) -> list[tuple[int, list[tuple]]]:

@@ -10,7 +10,6 @@ plus History/book-keeping.
 
 from __future__ import annotations
 
-import time
 from pathlib import Path
 
 import numpy as np
@@ -20,6 +19,7 @@ import jax.numpy as jnp
 
 from .. import constants
 from ..data.partition import StackedPartners, stack_eval_set
+from ..obs import trace as obs_trace
 from .engine import EvalSet, MplTrainer, TrainConfig
 from .history import History
 
@@ -126,46 +126,50 @@ class MultiPartnerLearning:
     # -- the fit driver -------------------------------------------------
 
     def fit(self):
-        start = time.perf_counter()
-        stacked, val, test = self._stage()
-        rng = jax.random.PRNGKey(self.seed)
-        state = self.trainer.init_state(rng, self.partners_count,
-                                        init_params=self._init_params(rng))
-        coal_mask = jnp.ones((self.partners_count,), jnp.float32)
+        # the fit span is the timer: learning_computation_time is its
+        # duration, and the span lands in the telemetry trace/report
+        with obs_trace.span("mpl.fit", approach=self.approach_key,
+                            partners=self.partners_count,
+                            epochs=self.epoch_count) as sp:
+            stacked, val, test = self._stage()
+            rng = jax.random.PRNGKey(self.seed)
+            state = self.trainer.init_state(rng, self.partners_count,
+                                            init_params=self._init_params(rng))
+            coal_mask = jnp.ones((self.partners_count,), jnp.float32)
 
-        chunk = self.cfg.patience if self.cfg.is_early_stopping else self.cfg.epoch_count
-        chunk = max(1, min(chunk, self.cfg.epoch_count))
-        run = self.trainer.jit_epoch_chunk
-        epochs_left = self.cfg.epoch_count
-        while epochs_left > 0:
-            n = min(chunk, epochs_left)
-            state = run(state, stacked, val, coal_mask, rng, n_epochs=n)
-            epochs_left -= n
-            if bool(jax.device_get(state.done)):
-                break
+            chunk = self.cfg.patience if self.cfg.is_early_stopping else self.cfg.epoch_count
+            chunk = max(1, min(chunk, self.cfg.epoch_count))
+            run = self.trainer.jit_epoch_chunk
+            epochs_left = self.cfg.epoch_count
+            while epochs_left > 0:
+                n = min(chunk, epochs_left)
+                state = run(state, stacked, val, coal_mask, rng, n_epochs=n)
+                epochs_left -= n
+                if bool(jax.device_get(state.done)):
+                    break
 
-        test_loss, test_acc = self.trainer.jit_finalize(state, test)
-        self._state = state
-        self.model_params = state.params
-        self.epoch_index = int(jax.device_get(state.epoch))
-        self.history.fill_from_state(
-            [p.id for p in self.partners_list],
-            state.val_loss_h, state.val_acc_h, state.partner_h,
-            int(jax.device_get(state.nb_epochs_done)), float(test_acc))
-        if self.approach_key == "lflip" and state.theta.size:
-            # Real per-epoch snapshots from the device-side [E, P, K, K]
-            # history; epochs never run (early stop) stay None, matching the
-            # reference's pre-filled list (multi_partner_learning.py:442).
-            theta_h = np.asarray(state.theta_h)
-            done = int(jax.device_get(state.nb_epochs_done))
-            self.history.theta = [
-                [theta_h[e, i] for i in range(self.partners_count)]
-                if e < done else [None] * self.partners_count
-                for e in range(self.epoch_count)]
-        if self.is_save_data:
-            self.save_final_model()
-            self.history.save_data()
-        self.learning_computation_time = time.perf_counter() - start
+            test_loss, test_acc = self.trainer.jit_finalize(state, test)
+            self._state = state
+            self.model_params = state.params
+            self.epoch_index = int(jax.device_get(state.epoch))
+            self.history.fill_from_state(
+                [p.id for p in self.partners_list],
+                state.val_loss_h, state.val_acc_h, state.partner_h,
+                int(jax.device_get(state.nb_epochs_done)), float(test_acc))
+            if self.approach_key == "lflip" and state.theta.size:
+                # Real per-epoch snapshots from the device-side [E, P, K, K]
+                # history; epochs never run (early stop) stay None, matching the
+                # reference's pre-filled list (multi_partner_learning.py:442).
+                theta_h = np.asarray(state.theta_h)
+                done = int(jax.device_get(state.nb_epochs_done))
+                self.history.theta = [
+                    [theta_h[e, i] for i in range(self.partners_count)]
+                    if e < done else [None] * self.partners_count
+                    for e in range(self.epoch_count)]
+            if self.is_save_data:
+                self.save_final_model()
+                self.history.save_data()
+        self.learning_computation_time = sp.duration
         return self.history.score
 
     # -- misc reference-API methods -------------------------------------

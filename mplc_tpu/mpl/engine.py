@@ -56,10 +56,53 @@ from jax import lax
 
 from .. import constants
 from ..models.core import Model
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..ops.aggregation import aggregate, aggregation_weights, broadcast
 from ..ops.metrics import masked_loss_and_metrics
 
 APPROACH_NAMES = ("fedavg", "seq-pure", "seq-with-final-agg", "seqavg", "lflip", "single")
+
+
+class _CompileTimedFn:
+    """Transparent wrapper around a jitted callable that records compile
+    events: when a call grows the jit's executable cache (a new program
+    shape — e.g. a new `n_epochs` static arg), the call's wall-clock is
+    attributed to compilation (`trainer.compile` trace event +
+    compile_seconds metrics). Dispatch is async under jit, so the first
+    call's time is dominated by trace+compile; steady-state calls see two
+    `perf_counter` reads and one int compare of overhead. Attribute access
+    (`.lower()`, `._cache_size()`, ...) passes through to the wrapped jit."""
+
+    __slots__ = ("_fn", "_label")
+
+    def __init__(self, fn, label: str):
+        self._fn = fn
+        self._label = label
+
+    def __call__(self, *args, **kwargs):
+        try:
+            before = self._fn._cache_size()
+        except Exception:
+            return self._fn(*args, **kwargs)
+        import time as _time
+        t0 = _time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        try:
+            grew = self._fn._cache_size() > before
+        except Exception:
+            grew = False
+        if grew:
+            dt = _time.perf_counter() - t0
+            obs_trace.event("trainer.compile", dur=dt, fn=self._label)
+            obs_metrics.counter("trainer.compiles_total").inc()
+            obs_metrics.counter("trainer.compile_seconds_total").inc(dt)
+            obs_metrics.counter(f"trainer.compiles[{self._label}]").inc()
+            obs_metrics.counter(f"trainer.compile_seconds[{self._label}]").inc(dt)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -188,35 +231,46 @@ class MplTrainer:
     @property
     def jit_epoch_chunk(self):
         if "epoch_chunk" not in self._jits:
-            self._jits["epoch_chunk"] = jax.jit(
-                self.epoch_chunk, static_argnames=("n_epochs",))
+            self._jits["epoch_chunk"] = _CompileTimedFn(jax.jit(
+                self.epoch_chunk, static_argnames=("n_epochs",)), "epoch_chunk")
         return self._jits["epoch_chunk"]
 
     @property
     def jit_finalize(self):
         if "finalize" not in self._jits:
-            self._jits["finalize"] = jax.jit(self.finalize)
+            self._jits["finalize"] = _CompileTimedFn(
+                jax.jit(self.finalize), "finalize")
         return self._jits["finalize"]
+
+    @property
+    def jit_evaluate(self):
+        if "evaluate" not in self._jits:
+            self._jits["evaluate"] = _CompileTimedFn(
+                jax.jit(self.evaluate), "evaluate")
+        return self._jits["evaluate"]
 
     @property
     def jit_batched_init(self):
         if "binit" not in self._jits:
-            self._jits["binit"] = jax.jit(
-                jax.vmap(self.init_state, in_axes=(0, None)), static_argnums=(1,))
+            self._jits["binit"] = _CompileTimedFn(jax.jit(
+                jax.vmap(self.init_state, in_axes=(0, None)),
+                static_argnums=(1,)), "batched_init")
         return self._jits["binit"]
 
     @property
     def jit_batched_epoch_chunk(self):
         if "brun" not in self._jits:
-            self._jits["brun"] = jax.jit(
+            self._jits["brun"] = _CompileTimedFn(jax.jit(
                 jax.vmap(self.epoch_chunk, in_axes=(0, None, None, 0, 0, None)),
-                static_argnames=("n_epochs",))
+                static_argnames=("n_epochs",)), "batched_epoch_chunk")
         return self._jits["brun"]
 
     @property
     def jit_batched_finalize(self):
         if "bfin" not in self._jits:
-            self._jits["bfin"] = jax.jit(jax.vmap(self.finalize, in_axes=(0, None)))
+            self._jits["bfin"] = _CompileTimedFn(
+                jax.jit(jax.vmap(self.finalize, in_axes=(0, None))),
+                "batched_finalize")
         return self._jits["bfin"]
 
     # ------------------------------------------------------------------

@@ -1,0 +1,16 @@
+"""Observability for the coalition engine: structured tracing (`trace`),
+a process-global metrics registry (`metrics`) and run reports (`report`).
+
+Zero dependencies beyond the stdlib; everything is host-side and adds no
+device syncs to the instrumented hot paths. Tracing emits JSONL when
+`MPLC_TPU_TRACE_FILE` is set (no-op otherwise); `report.sweep_report`
+turns collected spans into the compile/dispatch/harvest split, memo hit
+rate, padding waste and per-bucket throughput.
+"""
+
+from . import metrics, report, trace
+from .report import format_report, sweep_report, write_report
+from .trace import collect, event, span, start_span
+
+__all__ = ["metrics", "report", "trace", "span", "start_span", "event",
+           "collect", "sweep_report", "format_report", "write_report"]

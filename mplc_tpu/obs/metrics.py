@@ -1,0 +1,149 @@
+"""Process-global metrics registry: counters, gauges, histograms.
+
+The sweep telemetry layer (obs/trace.py gives *when*, this module gives
+*how much*): compile seconds, coalitions evaluated, memo hits/misses,
+padding waste, epochs trained, device-memory high water. Everything is
+host-side arithmetic — incrementing a counter never syncs the device.
+
+Metric names used by the instrumented paths:
+
+    trainer.compiles_total            counter  jit cache-miss compiles
+    trainer.compile_seconds_total     counter  wall-clock spent compiling
+    trainer.compiles[<fn>]            counter  per-executable compile count
+    trainer.compile_seconds[<fn>]     counter  per-executable compile time
+    engine.memo_hits                  counter  v(S) served from the memo
+    engine.memo_misses                counter  v(S) requiring training
+    engine.coalitions_evaluated       counter  coalitions actually trained
+    engine.epochs_trained             counter  coalition-epochs executed
+    engine.pad_waste_fraction         histogram per-batch padding fraction
+    engine.device_mem_high_water_bytes gauge   peak bytes (memory_stats)
+
+`snapshot()` exports the whole registry as a plain dict (JSON-ready);
+`reset()` clears it (tests and per-run report boundaries).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+_lock = threading.Lock()
+_registry: dict = {}
+
+
+class Counter:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        with _lock:
+            self.value += v
+
+
+class Gauge:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = None
+
+    def set(self, v: float) -> None:
+        with _lock:
+            self.value = v
+
+    def set_max(self, v: float) -> None:
+        """High-water-mark update (device_mem_high_water)."""
+        with _lock:
+            if self.value is None or v > self.value:
+                self.value = v
+
+
+class Histogram:
+    """Streaming count/sum/min/max/mean — enough for padding-waste and
+    batch-duration distributions without bucket-boundary bikeshedding."""
+
+    __slots__ = ("name", "count", "total", "min", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, v: float) -> None:
+        with _lock:
+            self.count += 1
+            self.total += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+
+def _get(name: str, cls):
+    m = _registry.get(name)
+    if m is None:
+        with _lock:
+            m = _registry.get(name)
+            if m is None:
+                m = _registry[name] = cls(name)
+    if not isinstance(m, cls):
+        raise TypeError(f"metric {name!r} is a {type(m).__name__}, "
+                        f"not a {cls.__name__}")
+    return m
+
+
+def counter(name: str) -> Counter:
+    return _get(name, Counter)
+
+
+def gauge(name: str) -> Gauge:
+    return _get(name, Gauge)
+
+
+def histogram(name: str) -> Histogram:
+    return _get(name, Histogram)
+
+
+def snapshot() -> dict:
+    """The whole registry as {counters, gauges, histograms} of plain
+    numbers — JSON-serializable, suitable for the sweep-report sidecar."""
+    with _lock:
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in sorted(_registry.items()):
+            if isinstance(m, Counter):
+                out["counters"][name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.value
+            else:
+                out["histograms"][name] = {
+                    "count": m.count, "sum": m.total,
+                    "min": m.min if m.count else None,
+                    "max": m.max if m.count else None,
+                    "mean": m.total / m.count if m.count else None,
+                }
+        return out
+
+
+def reset() -> None:
+    with _lock:
+        _registry.clear()
+
+
+def sample_device_memory(gauge_name: str = "engine.device_mem_high_water_bytes") -> None:
+    """Record the device's peak allocated bytes via `memory_stats()` (a
+    host-side query, no sync). No-op on backends without the API (CPU)."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+        if not stats:
+            return
+        peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+        if peak is not None:
+            gauge(gauge_name).set_max(int(peak))
+    except Exception:
+        pass

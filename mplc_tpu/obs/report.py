@@ -1,0 +1,170 @@
+"""Sweep reports: aggregate trace records + metrics into a per-run JSON
+sidecar and a human-readable summary.
+
+`sweep_report(records)` consumes the span/event records collected during a
+run (`obs.trace.collect()`, or a parsed JSONL trace file) and derives the
+quantities every perf PR needs as a measured before/after:
+
+  - wall-clock split: compile vs dispatch vs harvest inside the engine's
+    evaluate() time (compile happens *inside* the first dispatch/harvest of
+    each program, so the three components are reported raw, not disjoint);
+  - memo hit/miss counts and hit rate (from engine.evaluate span attrs);
+  - padding waste: padded slots / total batch slots over the whole run;
+  - per-(slot_count, width) bucket throughput: coalitions and epochs per
+    span-second (span-sum, which under MPLC_TPU_PIPELINE_BATCHES counts
+    overlapped batches twice — a utilization view, not a wall-clock one);
+  - per-executable compile counts/seconds and per-estimator durations.
+
+The report is derived from SPANS of the collected region only, so callers
+get a clean per-run view without resetting the process-global metrics
+registry; the registry snapshot can be attached for cumulative context.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def _attrs(rec: dict) -> dict:
+    return rec.get("attrs") or {}
+
+
+def sweep_report(records: list, metrics_snapshot: dict | None = None) -> dict:
+    """Aggregate a list of trace records (dicts) into the sweep report."""
+    evaluate_s = dispatch_s = harvest_s = compile_s = 0.0
+    requested = missing = 0
+    compiles: dict = {}
+    buckets: dict = {}
+    batches = coalitions = padding = epochs = 0
+    estimators = []
+    fits = []
+
+    for rec in records:
+        name = rec.get("name")
+        dur = float(rec.get("dur") or 0.0)
+        a = _attrs(rec)
+        if name == "engine.evaluate":
+            evaluate_s += dur
+            requested += int(a.get("requested", 0))
+            missing += int(a.get("missing", 0))
+        elif name == "engine.dispatch":
+            dispatch_s += dur
+        elif name == "engine.harvest":
+            harvest_s += dur
+        elif name == "trainer.compile":
+            compile_s += dur
+            fn = a.get("fn", "?")
+            c = compiles.setdefault(fn, {"count": 0, "seconds": 0.0})
+            c["count"] += 1
+            c["seconds"] += dur
+        elif name == "engine.batch":
+            k = (a.get("slot_count"), int(a.get("width", 0)))
+            b = buckets.setdefault(k, {"batches": 0, "coalitions": 0,
+                                       "padding": 0, "epochs": 0,
+                                       "seconds": 0.0})
+            b["batches"] += 1
+            b["coalitions"] += int(a.get("coalitions", 0))
+            b["padding"] += int(a.get("padding", 0))
+            b["epochs"] += int(a.get("epochs", 0))
+            b["seconds"] += dur
+            batches += 1
+            coalitions += int(a.get("coalitions", 0))
+            padding += int(a.get("padding", 0))
+            epochs += int(a.get("epochs", 0))
+        elif name == "contributivity":
+            estimators.append({"method": a.get("method", "?"), "seconds": dur})
+        elif name == "mpl.fit":
+            fits.append({"approach": a.get("approach", "?"), "seconds": dur})
+
+    slots_total = coalitions + padding
+    hits = requested_unique_hits = max(requested - missing, 0)
+    per_width = []
+    for (slot_count, width), b in sorted(
+            buckets.items(), key=lambda kv: (kv[0][0] is None,
+                                             kv[0][0] or 0, kv[0][1])):
+        s = b["seconds"]
+        per_width.append({
+            "slot_count": slot_count, "width": width, **b,
+            "coalitions_per_s": b["coalitions"] / s if s else None,
+            "epochs_per_s": b["epochs"] / s if s else None,
+        })
+
+    report = {
+        "wallclock": {
+            "evaluate_s": evaluate_s,
+            "compile_s": compile_s,
+            "dispatch_s": dispatch_s,
+            "harvest_s": harvest_s,
+        },
+        "memo": {
+            "requested": requested,
+            "hits": hits,
+            "misses": missing,
+            "hit_rate": requested_unique_hits / requested if requested else None,
+        },
+        "batches": {
+            "count": batches,
+            "coalitions": coalitions,
+            "padding": padding,
+            "pad_waste_fraction": padding / slots_total if slots_total else None,
+            "epochs_trained": epochs,
+        },
+        "per_width": per_width,
+        "compiles": compiles,
+        "estimators": estimators,
+    }
+    if fits:
+        report["fits"] = fits
+    if metrics_snapshot is not None:
+        report["metrics"] = metrics_snapshot
+    return report
+
+
+def format_report(report: dict) -> str:
+    """Human-readable summary table of a sweep_report() dict."""
+    w = report["wallclock"]
+    m = report["memo"]
+    b = report["batches"]
+    lines = ["sweep report:"]
+    lines.append(
+        f"  wall-clock  evaluate={w['evaluate_s']:.2f}s  "
+        f"compile={w['compile_s']:.2f}s  dispatch={w['dispatch_s']:.2f}s  "
+        f"harvest={w['harvest_s']:.2f}s")
+    hr = m["hit_rate"]
+    lines.append(
+        f"  memo        requested={m['requested']}  hits={m['hits']}  "
+        f"misses={m['misses']}  hit_rate="
+        + (f"{hr:.1%}" if hr is not None else "n/a"))
+    pw = b["pad_waste_fraction"]
+    lines.append(
+        f"  batches     n={b['count']}  coalitions={b['coalitions']}  "
+        f"padding={b['padding']}  pad_waste="
+        + (f"{pw:.1%}" if pw is not None else "n/a")
+        + f"  epochs={b['epochs_trained']}")
+    if report["per_width"]:
+        lines.append("  throughput per bucket (slots, width): "
+                     "batches  coal  epochs  span-s  coal/s")
+        for r in report["per_width"]:
+            cps = r["coalitions_per_s"]
+            lines.append(
+                f"    ({str(r['slot_count']):>4}, {r['width']:4d})      "
+                f"{r['batches']:4d}  {r['coalitions']:5d}  {r['epochs']:5d}  "
+                f"{r['seconds']:7.2f}  "
+                + (f"{cps:6.2f}" if cps is not None else "   n/a"))
+    for fn, c in sorted(report["compiles"].items()):
+        lines.append(f"  compile     {fn}: {c['count']}x  {c['seconds']:.2f}s")
+    for e in report["estimators"]:
+        lines.append(f"  estimator   {e['method']}: {e['seconds']:.2f}s")
+    return "\n".join(lines)
+
+
+def write_report(path: str, report: dict) -> None:
+    """Atomic JSON sidecar write (temp + rename, like the engine's
+    cache autosave)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    os.replace(tmp, path)

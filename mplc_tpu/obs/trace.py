@@ -1,0 +1,204 @@
+"""Zero-dependency structured tracing: spans with monotonic timing, nesting
+and an optional JSONL sink.
+
+The coalition engine's hot paths are instrumented with
+
+    with span("engine.dispatch", width=b, slot_count=k):
+        ...
+
+Spans always measure duration (two `perf_counter` calls and a thread-local
+list push/pop — nanoseconds, no device sync), but a span only *emits* a
+record when a sink is active:
+
+  - the JSONL file named by the `MPLC_TPU_TRACE_FILE` env var (checked at
+    span end, so tests and long-lived processes can flip it at runtime), or
+  - an in-memory collector opened with `collect()` (how `obs.report` and
+    `bench.py` gather a run's spans without touching the filesystem).
+
+With neither active the instrumentation is a no-op apart from the timing
+itself — no dict building, no serialization, no I/O.
+
+Record schema (one JSON object per line):
+
+    {"name": str, "id": int, "parent": int | null, "ts": float (epoch s),
+     "dur": float (s), "thread": int, "attrs": {...}}
+
+Nesting is per-thread (a thread-local span stack); `parent` links a span to
+the innermost span open on the same thread when it started. File writes are
+serialized by a module lock, so concurrent threads interleave whole lines,
+never partial ones.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+_local = threading.local()
+_ids = itertools.count(1)
+# (path, file) of the currently open JSONL sink; reopened when the env var
+# changes between spans. Guarded by _lock.
+_sink_state: dict = {"path": None, "file": None}
+# active in-memory collectors (lists appended to by _emit). Guarded by _lock.
+_collectors: list[list] = []
+
+TRACE_FILE_ENV = "MPLC_TPU_TRACE_FILE"
+
+
+def _stack() -> list:
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+def _sink_file():
+    """The open JSONL sink, or None. Re-opens when the env var changed.
+    An unopenable path degrades to a one-time warning, never an exception
+    into the instrumented hot path (the path stays recorded so the failed
+    open is not retried on every span)."""
+    path = os.environ.get(TRACE_FILE_ENV) or None
+    if path == _sink_state["path"]:
+        return _sink_state["file"]
+    with _lock:
+        if path != _sink_state["path"]:
+            if _sink_state["file"] is not None:
+                try:
+                    _sink_state["file"].close()
+                except OSError:
+                    pass
+            _sink_state["path"] = path
+            _sink_state["file"] = None
+            if path:
+                try:
+                    _sink_state["file"] = open(path, "a")
+                except OSError as e:
+                    import warnings
+                    warnings.warn(f"{TRACE_FILE_ENV}={path!r} could not be "
+                                  f"opened ({e}); tracing to file disabled")
+    return _sink_state["file"]
+
+
+def _emit(record: dict) -> None:
+    f = _sink_file()
+    if f is None and not _collectors:
+        return
+    with _lock:
+        for c in _collectors:
+            c.append(record)
+        if f is not None:
+            f.write(json.dumps(record) + "\n")
+            f.flush()
+
+
+def _active() -> bool:
+    return bool(_collectors) or bool(os.environ.get(TRACE_FILE_ENV))
+
+
+class Span:
+    """One timed region. Use as a context manager, or via `start_span` +
+    an explicit `end()` (for regions with early returns) / `cancel()`
+    (discard without emitting). `duration` is valid after exit."""
+
+    __slots__ = ("name", "attrs", "id", "parent", "ts", "_t0", "duration",
+                 "_closed")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.id = next(_ids)
+        st = _stack()
+        self.parent = st[-1].id if st else None
+        st.append(self)
+        self.ts = time.time()
+        self.duration = None
+        self._closed = False
+        self._t0 = time.perf_counter()
+
+    def _pop(self) -> None:
+        st = _stack()
+        # pop up to and including self: robust against out-of-order ends
+        # (an early-returning caller that leaked an inner span must not
+        # corrupt the nesting of everything that follows)
+        while st:
+            if st.pop() is self:
+                break
+
+    def end(self) -> "Span":
+        if self._closed:
+            return self
+        self.duration = time.perf_counter() - self._t0
+        self._closed = True
+        self._pop()
+        if _active():
+            _emit({"name": self.name, "id": self.id, "parent": self.parent,
+                   "ts": self.ts, "dur": self.duration,
+                   "thread": threading.get_ident(), "attrs": self.attrs})
+        return self
+
+    def cancel(self) -> None:
+        """Close without emitting (duration still recorded)."""
+        if self._closed:
+            return
+        self.duration = time.perf_counter() - self._t0
+        self._closed = True
+        self._pop()
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.end()
+        return False
+
+
+def span(name: str, **attrs) -> Span:
+    """Context manager: `with span("engine.run_batch", width=16): ...`"""
+    return Span(name, attrs)
+
+
+def start_span(name: str, **attrs) -> Span:
+    """Explicit-lifetime variant for regions that outlive one lexical
+    block; pair with `.end()` or `.cancel()`."""
+    return Span(name, attrs)
+
+
+def event(name: str, dur: float = 0.0, **attrs) -> None:
+    """Emit a point-in-time (or externally timed) record without opening a
+    span — e.g. a compile whose duration was measured by the caller."""
+    if not _active():
+        return
+    st = _stack()
+    _emit({"name": name, "id": next(_ids),
+           "parent": st[-1].id if st else None,
+           "ts": time.time(), "dur": float(dur),
+           "thread": threading.get_ident(), "attrs": attrs})
+
+
+class collect:
+    """Context manager capturing every record emitted while open:
+
+        with collect() as records:
+            ...
+        report = sweep_report(records)
+
+    Works with or without the JSONL file sink; nesting is allowed (each
+    collector sees every record emitted while it is open)."""
+
+    def __enter__(self) -> list:
+        self.records: list = []
+        with _lock:
+            _collectors.append(self.records)
+        return self.records
+
+    def __exit__(self, *exc) -> bool:
+        with _lock:
+            try:
+                _collectors.remove(self.records)
+            except ValueError:
+                pass
+        return False

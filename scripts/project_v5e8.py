@@ -40,19 +40,60 @@ def bucket_size(n: int, n_dev: int, cap_per_dev: int) -> int:
     return min(b, cap)
 
 
+def _call_groups(rows):
+    """Group progress rows into engine batch calls and mark evaluate()
+    boundaries. The 'left in call' counter reaches 0 at the end of every
+    _run_batch call (one slot bucket); a trailing incomplete call (wedge
+    mid-run) is dropped. Inside ONE engine.evaluate() the bucket calls run
+    back-to-back in ascending slot order (singles first), so a call whose
+    slot order does NOT increase over its predecessor's starts a new
+    evaluate() — the host gap before it (estimator code, sampler refits,
+    Kriging fits) is host time, not batch time. Yields (call_rows,
+    starts_new_evaluate). The log's first call is anchored at t=0 (the
+    progress timer starts right before the first evaluate), so it is not
+    a boundary.
+
+    Known blind spot: an evaluate() that begins at a STRICTLY larger slot
+    size than the previous call's last bucket is indistinguishable from an
+    intra-evaluate transition in the log, so that boundary is missed and
+    its first batch keeps the old cross-call delta. In practice IS/MC
+    blocks nearly always re-request the small sizes first (size 2/3
+    pairs), so the missed case is rare; fixing it for good needs an
+    explicit evaluate-id in the progress line."""
+    calls = []
+    cur = []
+    for r in rows:
+        cur.append(r)
+        if r[2] == 0:
+            calls.append(cur)
+            cur = []
+    prev_order = None
+    for call in calls:
+        order = 1 if call[0][1] is None else call[0][1]
+        yield call, (prev_order is not None and order <= prev_order)
+        prev_order = order
+
+
 def parse_batch_times(log_path):
     """Per-slot-size batch durations (s) from the timed progress lines.
 
-    Returns {slot_count_or_None: [durations]}, plus the width each size ran
-    at (all batches of one evaluate() call share one bucket width)."""
+    Returns {slot_count_or_None: [durations]}. All batches of one
+    evaluate() call share one bucket width. prev_t resets at evaluate()
+    boundaries: the first batch after a boundary absorbs inter-call
+    host/compile time, so its duration is unknowable from the log and it
+    contributes no sample (ADVICE r5)."""
     rows = parse_timed_rows(log_path)
     if not rows:
         raise SystemExit(f"no timed progress lines in {log_path}")
     times = {}
     prev_t = 0
-    for n, slots, _left, t in rows:
-        times.setdefault(slots, []).append(t - prev_t)
-        prev_t = t
+    for call, boundary in _call_groups(rows):
+        for idx, (_n, slots, _left, t) in enumerate(call):
+            if idx == 0 and boundary:
+                prev_t = t  # reset: the cross-evaluate gap is not batch time
+                continue
+            times.setdefault(slots, []).append(t - prev_t)
+            prev_t = t
     return times
 
 
@@ -86,24 +127,28 @@ def parse_is_log_ratios(log_path, record_cap=16):
     run used (it determines the recorded bucket widths — independent of
     the --cap being projected). Returns (w, t(k,w)/t(k, w_max)) ratio
     points pooled over slot sizes k that have a full-width cell, with
-    w_max = the mined run's single-device full width."""
+    w_max = the mined run's single-device full width.
+
+    prev_t resets at evaluate() boundaries (_call_groups): a batch whose
+    delta spans host-side estimator work between evaluate() calls would
+    otherwise pollute its steady-state cell — the IS workload's narrow
+    (width 1/2) buckets are single-batch calls, exactly the cells where a
+    host gap dwarfs the real batch time (ADVICE r5). The per-cell
+    first-occurrence drop below still excludes residual compiles that land
+    mid-evaluate (the first batch of a new (slots, width) program)."""
     rows = parse_timed_rows(log_path)
     w_max = bucket_size(record_cap, 1, record_cap)
     durs = {}
     prev_t = 0
-    i = 0
-    while i < len(rows):
-        j = i
-        while j < len(rows) and rows[j][2] != 0:
-            j += 1
-        if j >= len(rows):
-            break  # wedge mid-call: drop the incomplete trailing call
-        call_total = sum(r[0] for r in rows[i:j + 1])
+    for call, boundary in _call_groups(rows):
+        call_total = sum(r[0] for r in call)
         b = bucket_size(call_total, 1, record_cap)
-        for r in rows[i:j + 1]:
+        for idx, r in enumerate(call):
+            if idx == 0 and boundary:
+                prev_t = r[3]  # reset: cross-evaluate host gap excluded
+                continue
             durs.setdefault((r[1], b), []).append(r[3] - prev_t)
             prev_t = r[3]
-        i = j + 1
     steady = {kw: sum(ds[1:]) / len(ds[1:])
               for kw, ds in durs.items() if len(ds) > 1 and kw[0] is not None}
     pts = []

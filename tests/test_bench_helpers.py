@@ -85,6 +85,22 @@ def test_devices_deadline_returns_none_on_hang(monkeypatch):
     hang.set()
 
 
+def test_fallback_env_strip_covers_workload_knobs():
+    """The CPU fallback child must not inherit any workload-shaping knob;
+    keep _spawn_cpu_fallback's strip list superset-consistent with
+    _replay_cached_tpu_result's refusal list (ADVICE r5: the eval-chunk
+    knob was missing from both)."""
+    import inspect
+    src_replay = inspect.getsource(bench._replay_cached_tpu_result)
+    src_spawn = inspect.getsource(bench._spawn_cpu_fallback)
+    for knob in ("MPLC_TPU_EVAL_CHUNK", "BENCH_DTYPE",
+                 "MPLC_TPU_COALITIONS_PER_DEVICE", "MPLC_TPU_NO_SLOTS",
+                 "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
+                 "MPLC_TPU_SLOT_POW2", "MPLC_TPU_SYNTH_SCALE"):
+        assert knob in src_replay, f"{knob} missing from replay refusal"
+        assert knob in src_spawn, f"{knob} missing from fallback env strip"
+
+
 def test_cpu_fallback_refuses_to_recurse(monkeypatch):
     """The fallback child must never spawn another fallback."""
     monkeypatch.setenv("BENCH_IS_FALLBACK_CHILD", "1")
@@ -153,7 +169,8 @@ def test_replay_emits_newest_valid_record(tmp_path, monkeypatch, capsys):
 
     for knob in ("BENCH_CONFIG", "BENCH_PARTNERS", "BENCH_EPOCHS",
                  "BENCH_DATASET", "BENCH_METRIC_SUFFIX", "BENCH_DTYPE",
-                 "MPLC_TPU_SYNTH_SCALE", "MPLC_TPU_SLOT_POW2"):
+                 "MPLC_TPU_SYNTH_SCALE", "MPLC_TPU_SLOT_POW2",
+                 "MPLC_TPU_EVAL_CHUNK"):
         monkeypatch.delenv(knob, raising=False)
     old = _write_record(tmp_path, "r4",
                         "exact_shapley_mnist_10partners_8epochs_wallclock",
@@ -185,12 +202,18 @@ def test_replay_refuses_nondefault_workloads(tmp_path, monkeypatch, capsys):
                  "BENCH_DATASET", "BENCH_METRIC_SUFFIX", "BENCH_DTYPE",
                  "MPLC_TPU_SYNTH_SCALE", "MPLC_TPU_SLOT_POW2",
                  "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
-                 "MPLC_TPU_COALITIONS_PER_DEVICE"):
+                 "MPLC_TPU_COALITIONS_PER_DEVICE", "MPLC_TPU_EVAL_CHUNK",
+                 "MPLC_TPU_PIPELINE_BATCHES"):
         monkeypatch.delenv(knob, raising=False)
     for knob, bad in (("BENCH_EPOCHS", "2"), ("BENCH_CONFIG", "3"),
                       ("BENCH_PARTNERS", "6"), ("BENCH_DATASET", "titanic"),
                       ("MPLC_TPU_SYNTH_SCALE", "0.25"),
                       ("MPLC_TPU_SLOT_POW2", "1"), ("BENCH_DTYPE", "float32"),
+                      # the eval-chunk knob reshapes the compiled eval
+                      # program + the memory-derived batch cap: a cached
+                      # default-workload number must not be replayed for it
+                      ("MPLC_TPU_EVAL_CHUNK", "1024"),
+                      ("MPLC_TPU_PIPELINE_BATCHES", "1"),
                       ("BENCH_METRIC_SUFFIX", "_x")):
         monkeypatch.setenv(knob, bad)
         assert bench._replay_cached_tpu_result(str(tmp_path)) is False, knob
@@ -207,7 +230,7 @@ def test_replay_skips_malformed_records(tmp_path, monkeypatch, capsys):
                  "BENCH_DATASET", "BENCH_METRIC_SUFFIX", "BENCH_DTYPE",
                  "MPLC_TPU_SYNTH_SCALE", "MPLC_TPU_SLOT_POW2",
                  "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
-                 "MPLC_TPU_COALITIONS_PER_DEVICE"):
+                 "MPLC_TPU_COALITIONS_PER_DEVICE", "MPLC_TPU_EVAL_CHUNK"):
         # the tests' conftest sets MPLC_TPU_SYNTH_SCALE ambiently — the
         # gate must see the driver's clean default env here
         monkeypatch.delenv(knob, raising=False)

@@ -1,0 +1,279 @@
+"""The observability layer: span nesting/timing, JSONL schema round-trip,
+metrics snapshot correctness, the no-op path with MPLC_TPU_TRACE_FILE
+unset, compile-event tracking, and an end-to-end smoke test that a tiny
+CharacteristicEngine sweep produces a well-formed sweep report whose memo
+accounting, padding waste and epoch counts match hand-computed values."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mplc_tpu.obs import metrics, report, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs(monkeypatch):
+    """Isolate each test: no ambient trace file, fresh metrics registry."""
+    monkeypatch.delenv("MPLC_TPU_TRACE_FILE", raising=False)
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# -- spans -------------------------------------------------------------------
+
+def test_span_nesting_and_timing():
+    with trace.collect() as recs:
+        with trace.span("outer", label="a") as outer:
+            with trace.span("inner") as inner:
+                pass
+        with trace.span("sibling") as sib:
+            pass
+    assert [r["name"] for r in recs] == ["inner", "outer", "sibling"]
+    by_name = {r["name"]: r for r in recs}
+    # nesting: inner's parent is outer; siblings are roots
+    assert by_name["inner"]["parent"] == by_name["outer"]["id"]
+    assert by_name["outer"]["parent"] is None
+    assert by_name["sibling"]["parent"] is None
+    # timing: monotonic durations, outer covers inner
+    assert outer.duration >= inner.duration >= 0.0
+    assert by_name["outer"]["dur"] == outer.duration
+    assert by_name["outer"]["attrs"] == {"label": "a"}
+    assert sib.duration >= 0.0
+
+
+def test_start_span_end_and_cancel():
+    with trace.collect() as recs:
+        sp = trace.start_span("explicit", k=1)
+        sp.end()
+        dropped = trace.start_span("dropped")
+        dropped.cancel()
+        # cancel still measures (contributivity's early-exit path relies
+        # on end/cancel both recording duration)
+        assert dropped.duration is not None
+    assert [r["name"] for r in recs] == ["explicit"]
+    # double-end is idempotent
+    d = sp.duration
+    sp.end()
+    assert sp.duration == d
+
+
+def test_leaked_inner_span_does_not_corrupt_nesting():
+    with trace.collect() as recs:
+        outer = trace.start_span("outer")
+        trace.start_span("leaked")  # never ended
+        outer.end()                 # pops through the leaked span
+        with trace.span("next"):
+            pass
+    nxt = [r for r in recs if r["name"] == "next"][0]
+    assert nxt["parent"] is None
+
+
+def test_event_records_external_duration():
+    with trace.collect() as recs:
+        trace.event("trainer.compile", dur=1.25, fn="unit")
+    assert recs[0]["dur"] == 1.25
+    assert recs[0]["attrs"] == {"fn": "unit"}
+
+
+def test_spans_are_thread_safe():
+    with trace.collect() as recs:
+        def work(tag):
+            with trace.span(f"outer-{tag}"):
+                with trace.span(f"inner-{tag}"):
+                    pass
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert len(recs) == 8
+    by_name = {r["name"]: r for r in recs}
+    for i in range(4):
+        # each thread's nesting is private: inner-i parents to outer-i
+        assert by_name[f"inner-{i}"]["parent"] == by_name[f"outer-{i}"]["id"]
+
+
+# -- JSONL sink --------------------------------------------------------------
+
+def test_jsonl_sink_round_trip(tmp_path, monkeypatch):
+    path = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("MPLC_TPU_TRACE_FILE", str(path))
+    with trace.span("engine.evaluate", requested=3, missing=2):
+        with trace.span("engine.dispatch", width=8):
+            pass
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 2
+    recs = [json.loads(l) for l in lines]
+    for r in recs:
+        assert set(r) == {"name", "id", "parent", "ts", "dur", "thread",
+                          "attrs"}
+        assert isinstance(r["dur"], float) and r["dur"] >= 0.0
+    dispatch, evaluate = recs  # inner span closes (and is written) first
+    assert dispatch["name"] == "engine.dispatch"
+    assert dispatch["parent"] == evaluate["id"]
+    assert evaluate["attrs"] == {"requested": 3, "missing": 2}
+
+
+def test_noop_when_trace_file_unset(tmp_path):
+    before = set(tmp_path.iterdir())
+    with trace.span("hot.path", width=16) as sp:
+        pass
+    # duration still measured, but nothing emitted anywhere
+    assert sp.duration is not None
+    assert set(tmp_path.iterdir()) == before
+    # the sink resolves to None with the env unset (a handle left over
+    # from an earlier traced region is closed on re-sync)
+    assert trace._sink_file() is None
+
+
+# -- metrics -----------------------------------------------------------------
+
+def test_metrics_snapshot_correctness():
+    metrics.counter("c").inc()
+    metrics.counter("c").inc(2.5)
+    metrics.gauge("g").set(7)
+    metrics.gauge("hw").set_max(10)
+    metrics.gauge("hw").set_max(4)      # lower: high-water keeps 10
+    for v in (0.0, 0.5, 1.0):
+        metrics.histogram("h").observe(v)
+    snap = metrics.snapshot()
+    assert snap["counters"]["c"] == 3.5
+    assert snap["gauges"]["g"] == 7
+    assert snap["gauges"]["hw"] == 10
+    assert snap["histograms"]["h"] == {
+        "count": 3, "sum": 1.5, "min": 0.0, "max": 1.0, "mean": 0.5}
+    # registry is get-or-create; a name can't silently change type
+    with pytest.raises(TypeError):
+        metrics.gauge("c")
+    metrics.reset()
+    assert metrics.snapshot() == {"counters": {}, "gauges": {},
+                                  "histograms": {}}
+
+
+def test_sample_device_memory_never_raises():
+    # CPU backends have no memory_stats — must be a silent no-op
+    metrics.sample_device_memory()
+
+
+# -- compile tracking --------------------------------------------------------
+
+def test_compile_timed_fn_records_cache_growth():
+    from mplc_tpu.mpl.engine import _CompileTimedFn
+
+    f = _CompileTimedFn(jax.jit(lambda x: x + 1), "unit")
+    with trace.collect() as recs:
+        f(jnp.ones(3))   # first shape: compile
+        f(jnp.ones(3))   # cached: no event
+        f(jnp.ones(5))   # new shape: compile
+    compiles = [r for r in recs if r["name"] == "trainer.compile"]
+    assert len(compiles) == 2
+    assert all(r["attrs"]["fn"] == "unit" for r in compiles)
+    snap = metrics.snapshot()["counters"]
+    assert snap["trainer.compiles_total"] == 2
+    assert snap["trainer.compiles[unit]"] == 2
+    assert snap["trainer.compile_seconds_total"] > 0
+    # attribute passthrough to the wrapped jit (tests .lower() the jits)
+    assert hasattr(f, "lower")
+
+
+# -- contributivity spans ----------------------------------------------------
+
+def test_estimator_timing_comes_from_span():
+    from test_contrib import additive, fake_scenario
+
+    from mplc_tpu.contrib.contributivity import Contributivity
+
+    sc = fake_scenario(3, additive([0.1, 0.25, 0.65]))
+    c = Contributivity(sc)
+    with trace.collect() as recs:
+        c.compute_SV()
+    spans = [r for r in recs if r["name"] == "contributivity"]
+    assert len(spans) == 1
+    assert spans[0]["attrs"]["method"] == "Shapley"
+    # single source of truth: the public timing IS the span duration
+    assert c.computation_time_sec == spans[0]["dur"] > 0.0
+
+
+# -- report + engine smoke ---------------------------------------------------
+
+def test_report_format_and_write(tmp_path):
+    rep = report.sweep_report([
+        {"name": "engine.evaluate", "dur": 2.0,
+         "attrs": {"requested": 4, "missing": 1}},
+        {"name": "engine.batch", "dur": 1.5,
+         "attrs": {"width": 8, "slot_count": 2, "coalitions": 6,
+                   "padding": 2, "epochs": 24}},
+        {"name": "trainer.compile", "dur": 0.5, "attrs": {"fn": "brun"}},
+    ])
+    assert rep["memo"] == {"requested": 4, "hits": 3, "misses": 1,
+                           "hit_rate": 0.75}
+    assert rep["batches"]["pad_waste_fraction"] == 0.25
+    assert rep["per_width"][0]["coalitions_per_s"] == 4.0
+    text = report.format_report(rep)
+    assert "hit_rate=75.0%" in text
+    assert "pad_waste=25.0%" in text
+    path = tmp_path / "rep.json"
+    report.write_report(str(path), rep)
+    assert json.loads(path.read_text())["memo"]["hits"] == 3
+
+
+def test_engine_smoke_sweep_report(tmp_path, monkeypatch):
+    """A tiny real-engine sweep with tracing on: JSONL trace written,
+    and the sweep report's memo counts, padding waste and epoch totals
+    equal the hand-computed values for this workload."""
+    from helpers import build_scenario, cluster_mlp_dataset
+
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+
+    monkeypatch.setenv("MPLC_TPU_TRACE_FILE", str(tmp_path / "trace.jsonl"))
+    sc = build_scenario(dataset=cluster_mlp_dataset(n=240), epoch_count=2)
+    eng = CharacteristicEngine(sc)
+    with trace.collect() as recs:
+        eng.evaluate([(0,), (1,), (0, 1)])   # 3 misses
+        eng.evaluate([(0,), (1,), (0, 1)])   # 3 hits, all memoized
+    rep = report.sweep_report(recs)
+
+    # memo accounting: 3 unique keys requested per call
+    assert rep["memo"] == {"requested": 6, "hits": 3, "misses": 3,
+                           "hit_rate": 0.5}
+    # padding: the 8-device CPU mesh buckets both batches to width 8
+    # (2 singles -> 6 padded; 1 size-2 coalition -> 7 padded)
+    assert rep["batches"]["count"] == 2
+    assert rep["batches"]["coalitions"] == 3
+    assert rep["batches"]["padding"] == 6 + 7
+    assert rep["batches"]["pad_waste_fraction"] == 13 / 16
+    # epochs: ES off at epoch_count=2 <= patience, so every coalition
+    # trains the full 2 epochs
+    assert rep["batches"]["epochs_trained"] == 3 * 2
+    assert eng.epochs_trained == 6
+    # wall-clock split present; the cold engine compiled inside the region
+    assert rep["wallclock"]["evaluate_s"] > 0
+    assert rep["wallclock"]["dispatch_s"] > 0
+    assert rep["wallclock"]["harvest_s"] > 0
+    assert rep["compiles"], "cold sweep must record compile events"
+    assert rep["wallclock"]["compile_s"] > 0
+
+    # metrics mirrored the same quantities
+    snap = metrics.snapshot()
+    assert snap["counters"]["engine.memo_hits"] == 3
+    assert snap["counters"]["engine.memo_misses"] == 3
+    assert snap["counters"]["engine.epochs_trained"] == 6
+    assert snap["histograms"]["engine.pad_waste_fraction"]["count"] == 2
+
+    # the JSONL trace parses line-by-line and contains the same spans
+    lines = (tmp_path / "trace.jsonl").read_text().strip().splitlines()
+    parsed = [json.loads(l) for l in lines]
+    names = {r["name"] for r in parsed}
+    assert {"engine.evaluate", "engine.dispatch", "engine.harvest",
+            "engine.batch"} <= names
+    # dispatch/harvest spans nest under their evaluate span
+    ev_ids = {r["id"] for r in parsed if r["name"] == "engine.evaluate"}
+    for r in parsed:
+        if r["name"] in ("engine.dispatch", "engine.harvest"):
+            assert r["parent"] in ev_ids

@@ -48,10 +48,16 @@ def test_is_log_mining_pins_the_measured_curve():
     # known steady-state cells (s/batch) from the wedged IS run
     assert steady[(3, 16)] == pytest.approx(18.43, abs=0.1)
     assert steady[(7, 8)] == pytest.approx(21.0, abs=0.1)
-    assert steady[(2, 2)] == pytest.approx(1.5, abs=0.1)
-    # 8 pooled ratio points at widths 2/4/8, all well below flat scaling
-    assert len(pts) == 8
-    assert {w for w, _ in pts} == {2, 4, 8}
+    # the formerly-polluted narrow cells are GONE: the IS log's width-1/2
+    # buckets are single-batch calls sitting at evaluate() boundaries, so
+    # their deltas were host estimator time, not batch time (ADVICE r5 —
+    # prev_t now resets at those boundaries)
+    assert (2, 2) not in steady
+    assert (2, 1) not in steady
+    assert (3, 1) not in steady
+    # 4 pooled ratio points at widths 4/8, all well below flat scaling
+    assert len(pts) == 4
+    assert {w for w, _ in pts} == {4, 8}
     for w, r in pts:
         assert r < 0.6, (w, r)            # refutes the latency-bound prior
         assert r == pytest.approx(w / 16.0, abs=0.06)  # ~linear in width
@@ -77,8 +83,12 @@ def test_truncated_log_drops_incomplete_trailing_call(tmp_path):
     trunc = tmp_path / "trunc.log"
     trunc.write_text("\n".join(lines[:cut + 1]))
     pts, steady = proj.parse_is_log_ratios(str(trunc), record_cap=16)
-    assert pts                      # still mines the complete calls
+    assert steady                   # still mines the complete calls
     assert (3, 16) in steady        # early complete calls survive the cut
+    # cells that survive the cut agree with the full-log mining
+    _, steady_full = proj.parse_is_log_ratios(str(R4_ISLOG), record_cap=16)
+    for kw, v in steady.items():
+        assert v == pytest.approx(steady_full[kw], rel=0.35), kw
 
 
 @pytest.mark.skipif(not (R4_SWEEP.exists() and R4_ISLOG.exists()),
