@@ -38,10 +38,13 @@ the reference's own cost counter (contributivity.py:73).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Env knobs: BENCH_CONFIG, BENCH_PARTNERS, BENCH_EPOCHS (default 8),
 BENCH_METHOD, BENCH_DTYPE (default bfloat16 on TPU, float32 on CPU),
-MPLC_TPU_NO_SLOTS=1 for masked full-width execution, MPLC_TPU_SYNTH_SCALE
-for smaller data on CPU smoke runs, MPLC_TPU_SYNTH_NOISE (default 0.75
-here: accuracy must not saturate, or every Shapley value degenerates to
-1/N — BENCH_r02's flaw).
+MPLC_TPU_NO_SLOTS=1 for masked full-width execution, MPLC_TPU_SLOT_MERGE=0
+/ MPLC_TPU_SLOT_POW2=1 for the exact / pow2 slot bucketings (default:
+merged adjacent sizes), MPLC_TPU_PIPELINE_BATCHES=0 to opt out of batch
+overlap, MPLC_TPU_BATCH_CAP_CEILING to lift the batch-cap autotune past
+16, MPLC_TPU_SYNTH_SCALE for smaller data on CPU smoke runs,
+MPLC_TPU_SYNTH_NOISE (default 0.75 here: accuracy must not saturate, or
+every Shapley value degenerates to 1/N — BENCH_r02's flaw).
 """
 
 import json
@@ -163,11 +166,15 @@ def _replay_cached_tpu_result(repo_root: str | None = None) -> bool:
     # any workload-shaping knob off its default makes the cached full-scale
     # measurement a DIFFERENT workload — same set _spawn_cpu_fallback strips
     # (MPLC_TPU_EVAL_CHUNK changes the compiled eval program and the
-    # memory-derived batch cap, so it shapes the workload too)
-    for knob in ("BENCH_DTYPE", "MPLC_TPU_COALITIONS_PER_DEVICE",
+    # memory-derived batch cap, so it shapes the workload too; any SET
+    # value refuses, so the pipelining opt-out "0" and merge opt-out "0"
+    # also block replay of the default-workload number)
+    for knob in ("BENCH_DTYPE", "MPLC_TPU_BATCH_CAP_CEILING",
+                 "MPLC_TPU_COALITIONS_PER_DEVICE",
                  "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_NO_SLOTS",
                  "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
-                 "MPLC_TPU_SLOT_POW2", "MPLC_TPU_SYNTH_SCALE"):
+                 "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
+                 "MPLC_TPU_SYNTH_SCALE"):
         if os.environ.get(knob):
             return False
     import glob
@@ -232,10 +239,12 @@ def _spawn_cpu_fallback() -> int:
     # child, or fallback numbers vary with whatever TPU tuning was set —
     # and a tight accelerator stall/init timeout would re-arm the child's
     # watchdog, which is deliberately off on CPU.
-    for knob in ("BENCH_DTYPE", "MPLC_TPU_COALITIONS_PER_DEVICE",
+    for knob in ("BENCH_DTYPE", "MPLC_TPU_BATCH_CAP_CEILING",
+                 "MPLC_TPU_COALITIONS_PER_DEVICE",
                  "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_NO_SLOTS",
                  "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
-                 "MPLC_TPU_SLOT_POW2", "MPLC_TPU_SYNTH_SCALE",
+                 "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
+                 "MPLC_TPU_SYNTH_SCALE",
                  "BENCH_STALL_TIMEOUT", "BENCH_INIT_TIMEOUT",
                  # the child writes its own _cpu_fallback-suffixed sidecar;
                  # inheriting an explicit path would race the parent's file
@@ -311,12 +320,13 @@ def _attach_progress(engine, label):
 
 def _warm_engine(sc):
     """Compile every program the timed run will execute. The engine pads
-    each evaluate() call to one bucket width per coalition size
-    (contrib/engine.py _run_batch), so warming with min(C(n,k), n_dev*cap)
-    distinct subsets per size hits exactly the (width, slot-size) programs a
-    full sweep uses. Adaptive MC methods can still trigger one smaller
-    width on a late, short batch — that residual compile is accepted and
-    visible, not hidden."""
+    each evaluate() call to one bucket width per slot bucket
+    (contrib/engine.py _run_batch / _slot_buckets), so warming with
+    min(bucket count, n_dev*cap) distinct subsets per bucket — sizes
+    grouped by engine._slot_width, overlap-halved cap mirrored — hits
+    exactly the (width, slot-size) programs a full sweep uses. Adaptive MC
+    methods can still trigger one smaller width on a late, short batch —
+    that residual compile is accepted and visible, not hidden."""
     from itertools import combinations, islice
     from math import comb
 
@@ -325,20 +335,38 @@ def _warm_engine(sc):
     warm = _attach_progress(CharacteristicEngine(sc), "warm")
     n = warm.partners_count
     n_dev = max(warm._sharding.num_devices if warm._sharding else 1, 1)
+    # mirror _run_batch's effective cap: under the default batch overlap
+    # the memory-derived cap is halved, and the warmed batch width must
+    # equal the width the timed sweep will run
+    ov_single = warm._pipeline_batches and warm.single_pipe.dispatches_async
+    ov_multi = warm._pipeline_batches and warm.multi_pipe.dispatches_async
 
-    print(f"[bench] warm-up: singles ({min(n, n_dev * warm._device_batch_cap(None))} "
-          f"coalitions, compiling the single-partner pipeline)",
-          file=sys.stderr, flush=True)
-    warm.evaluate([(i,) for i in
-                   range(min(n, n_dev * warm._device_batch_cap(None)))])
+    n_singles = min(n, n_dev * warm._device_batch_cap(None, ov_single))
+    print(f"[bench] warm-up: singles ({n_singles} coalitions, compiling "
+          f"the single-partner pipeline)", file=sys.stderr, flush=True)
+    warm.evaluate([(i,) for i in range(n_singles)])
     if warm._use_slots:
+        # group sizes exactly as the sweep's _slot_buckets will (one merged
+        # width can cover several sizes), so the warmed batch widths match
+        # the timed run's — warming per raw size under merge mode would
+        # compile narrower tail programs the sweep never executes
+        by_width: dict[int, list[int]] = {}
         for k in range(2, n + 1):
-            w = min(comb(n, k), n_dev * warm._device_batch_cap(k))
-            print(f"[bench] warm-up: size={k} width={w} (compiling the "
-                  f"{k}-slot pipeline)", file=sys.stderr, flush=True)
-            warm.evaluate(list(islice(combinations(range(n), k), w)))
+            by_width.setdefault(warm._slot_width(k), []).append(k)
+        for width, ks in sorted(by_width.items()):
+            total = sum(comb(n, k) for k in ks)
+            w = min(total, n_dev * warm._device_batch_cap(width, ov_multi))
+            subsets = []
+            for k in ks:
+                subsets += list(islice(combinations(range(n), k),
+                                       w - len(subsets)))
+                if len(subsets) >= w:
+                    break
+            print(f"[bench] warm-up: sizes={ks} width={w} (compiling the "
+                  f"{width}-slot pipeline)", file=sys.stderr, flush=True)
+            warm.evaluate(subsets)
     else:
-        w = min(2 ** n - 1 - n, n_dev * warm._device_batch_cap(None))
+        w = min(2 ** n - 1 - n, n_dev * warm._device_batch_cap(None, ov_multi))
         multis = []
         for k in range(2, n + 1):
             multis += list(islice(combinations(range(n), k), w - len(multis)))

@@ -87,3 +87,11 @@ def _env_positive_int(name: str, default: int) -> int:
 
 
 EVAL_CHUNK_SIZE = _env_positive_int("MPLC_TPU_EVAL_CHUNK", 2048)
+
+# Ceiling for the HBM-derived coalitions-per-device autotune
+# (contrib/engine.py _device_batch_cap). 16 is the measured sweet spot for
+# per-size slot programs (cap-32 bisect, perf/r4/tune_cap32.log); with
+# MPLC_TPU_SLOT_MERGE bounding the program count the ceiling is worth
+# raising on chips with HBM headroom — override with
+# MPLC_TPU_BATCH_CAP_CEILING (read at cap-computation time, not import).
+BATCH_CAP_CEILING_ENV = "MPLC_TPU_BATCH_CAP_CEILING"

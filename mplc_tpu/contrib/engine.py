@@ -53,6 +53,27 @@ def _bucket_size(n: int, n_dev: int, cap_per_dev: int) -> int:
     return min(b, cap)
 
 
+@jax.jit
+def _fold_bitmask_keys(seed_key: jax.Array, words: jax.Array,
+                       n_words: jax.Array) -> jax.Array:
+    """Vectorized `_coalition_rng`: one vmapped fold over a [B, W] uint32
+    bitmask-word array instead of B host loops of chained fold_in dispatches.
+    `n_words[i]` is the per-row fold count (the scalar path folds only up to
+    the highest non-zero word, minimum one), so the key streams are
+    bit-identical to the loop for every partner count — trailing zero words
+    are computed but discarded by the `where`, never folded in."""
+    W = words.shape[1]
+
+    def one(wrow, n):
+        key = seed_key
+        for w in range(W):          # static unroll; W = ceil(P/32), 1 for P<32
+            folded = jax.random.fold_in(key, wrow[w])
+            key = jnp.where(w < n, folded, key)
+        return key
+
+    return jax.vmap(one)(words, n_words)
+
+
 class BatchedTrainerPipeline:
     """Jitted init -> epoch-chunk -> finalize pipeline, vmapped over coalitions."""
 
@@ -255,14 +276,32 @@ class CharacteristicEngine:
         self._use_slots = (multi_cfg.approach == "fedavg"
                            and os.environ.get("MPLC_TPU_NO_SLOTS") != "1")
         self._slot_pow2 = os.environ.get("MPLC_TPU_SLOT_POW2") == "1"
+        # Slot-bucket merging (the default between `exact` and `pow2`):
+        # adjacent coalition sizes share one slot program — size k rides
+        # size k+1's width for even k — so a 10-partner sweep compiles 5
+        # slot programs instead of 9 and the smaller size's tail coalitions
+        # fill batch rows the larger size would have padded. The `-1`
+        # unused-slot convention makes the mixed widths exact, not
+        # approximate (_slot_buckets). MPLC_TPU_SLOT_MERGE=0 restores the
+        # tight per-size grouping; an explicit MPLC_TPU_SLOT_POW2=1 wins.
+        self._slot_merge = (not self._slot_pow2
+                            and os.environ.get("MPLC_TPU_SLOT_MERGE")
+                            not in ("0", "exact"))
         # Batch pipelining: dispatch batch i+1 while batch i computes, so
-        # the device never idles through host-side mask building, transfers
+        # the device never idles through host-side batch prep, transfers
         # and result fetches between batches (the dispatch-gap component of
-        # the non-MFU time). Opt-in until chip-measured; results are
-        # identical (same executables, same per-coalition rng streams —
-        # only the harvest point moves).
-        self._pipeline_batches = os.environ.get("MPLC_TPU_PIPELINE_BATCHES") == "1"
+        # the non-MFU time). Default ON (results are identical — same
+        # executables, same per-coalition rng streams, only the harvest
+        # point moves); MPLC_TPU_PIPELINE_BATCHES=0 opts out.
+        self._pipeline_batches = \
+            os.environ.get("MPLC_TPU_PIPELINE_BATCHES", "1") != "0"
         self._slot_pipes: dict[int, BatchedTrainerPipeline] = {}
+        # 2-D singles pipelines, keyed by bucket width (the data-sliced
+        # singles path binds partners_count to the batch width)
+        self._singles_pipes: dict[int, BatchedTrainerPipeline] = {}
+        self._seed_key = jax.random.PRNGKey(self.seed)
+        # fold words per 32 partner indices (matches _coalition_rng's loop)
+        self._rng_word_count = max(1, (self.partners_count + 31) // 32)
 
         # 2-D [coal, part] mode (MPLC_TPU_PARTNER_SHARDS=p): shard the
         # partner dimension over p devices inside every coalition training,
@@ -301,8 +340,9 @@ class CharacteristicEngine:
         # rationale as the partner_shards write-back above) — after the 2-D
         # branch, which disables slot execution entirely
         scenario.slot_bucketing = (
-            "pow2" if (self._use_slots and self._slot_pow2)
-            else "exact" if self._use_slots else "masked")
+            "masked" if not self._use_slots
+            else "pow2" if self._slot_pow2
+            else "merge" if self._slot_merge else "exact")
 
         self.charac_fct_values: dict[tuple, float] = {(): 0.0}
         self.increments_values = [dict() for _ in range(self.partners_count)]
@@ -320,11 +360,12 @@ class CharacteristicEngine:
         mbc = multi_cfg.minibatch_count
         self._epoch_samples_multi = sizes_np // mbc * mbc
         self._epoch_samples_single = sizes_np
-        # When set, the memo cache is persisted after EVERY device batch, so
-        # a crash mid-sweep loses at most one batch of trained coalitions
-        # (the reference loses everything — it checkpoints nothing). Under
-        # MPLC_TPU_PIPELINE_BATCHES a second batch can be in flight when a
-        # hard kill lands, so the loss bound there is up to TWO batches.
+        # When set, the memo cache is persisted after EVERY device batch.
+        # With batch pipelining (the default) a second batch can be in
+        # flight when a hard kill lands, so a crash mid-sweep loses up to
+        # TWO batches of trained coalitions; with the overlap opted out
+        # (MPLC_TPU_PIPELINE_BATCHES=0) at most one. (The reference loses
+        # everything — it checkpoints nothing.)
         self.autosave_path = None
         # Optional callable(done_in_group, remaining_in_call, slot_count)
         # invoked after every completed device batch — long sweeps (and the
@@ -352,21 +393,82 @@ class CharacteristicEngine:
             if not bits:
                 return key
 
+    def _rng_fold_words(self, subsets: list[tuple]) -> tuple[np.ndarray,
+                                                             np.ndarray]:
+        """Whole-call rng prep for `_fold_bitmask_keys`: the [N, W] uint32
+        membership-bitmask words of every subset (one NumPy scatter, no
+        device dispatch) plus the per-row fold count — the index of the
+        highest non-zero word + 1, minimum one, exactly the scalar loop's
+        iteration count."""
+        n = len(subsets)
+        W = self._rng_word_count
+        words = np.zeros((n, W), np.uint32)
+        lens = np.fromiter((len(s) for s in subsets), np.intp, n)
+        total = int(lens.sum())
+        if total:
+            rows = np.repeat(np.arange(n), lens)
+            members = np.fromiter((int(i) for s in subsets for i in s),
+                                  np.int64, total)
+            np.bitwise_or.at(
+                words, (rows, members >> 5),
+                (np.uint32(1) << (members & 31).astype(np.uint32)))
+        nz = words != 0
+        n_words = np.where(nz.any(axis=1),
+                           W - np.argmax(nz[:, ::-1], axis=1),
+                           1).astype(np.int32)
+        return words, n_words
+
+    def _coalition_arrays(self, subsets: list[tuple],
+                          slot_count: int | None) -> np.ndarray:
+        """Whole-call coalition-argument prep: the [N, slot_count] int32
+        slot-id rows (-1 = unused slot) or [N, P] float32 masks for every
+        subset, built with one NumPy scatter instead of a per-batch Python
+        fill loop."""
+        n = len(subsets)
+        lens = np.fromiter((len(s) for s in subsets), np.intp, n)
+        total = int(lens.sum())
+        rows = np.repeat(np.arange(n), lens)
+        members = np.fromiter((int(i) for s in subsets for i in sorted(s)),
+                              np.int64, total)
+        if slot_count is not None:
+            coal = np.full((n, slot_count), -1, np.int32)
+            starts = np.cumsum(lens) - lens
+            cols = np.arange(total) - np.repeat(starts, lens)
+            coal[rows, cols] = members
+        else:
+            coal = np.zeros((n, self.partners_count), np.float32)
+            coal[rows, members] = 1.0
+        return coal
+
+    def _batch_rngs(self, words: np.ndarray, n_words: np.ndarray,
+                    sel: np.ndarray) -> jax.Array:
+        """[b, 2] per-coalition keys for one padded batch (rows selected by
+        `sel` from the whole-call fold words), bit-identical to stacking
+        `_coalition_rng` per subset — equality-tested."""
+        return _fold_bitmask_keys(self._seed_key, jnp.asarray(words[sel]),
+                                  jnp.asarray(n_words[sel]))
+
     def _device_batch_cap(self, slot_count: int | None = None,
                           overlap: bool = False) -> int:
         """Coalitions per device per compiled batch.
 
-        Ceiling = constants.MAX_COALITIONS_PER_DEVICE_BATCH (16): larger
-        power-of-two buckets would each compile their own program per slot
-        size, exploding compile time for marginal dispatch savings. The cap
-        autotunes DOWN when the per-coalition HBM footprint (params x
-        (1 global + slots trained in flight + adam moments + grads) plus the
-        eval-chunk activation window) would overflow ~50% of device memory.
-        Override with MPLC_TPU_COALITIONS_PER_DEVICE.
+        Ceiling = constants.MAX_COALITIONS_PER_DEVICE_BATCH (16) by
+        default: larger power-of-two buckets would each compile their own
+        program per slot size, exploding compile time for marginal dispatch
+        savings. With MPLC_TPU_SLOT_MERGE bounding the program count the
+        ceiling is worth raising — MPLC_TPU_BATCH_CAP_CEILING lifts it
+        (same sweep protocol as the cap-32 bisect,
+        scripts/tune_coalition_cap.py). The cap autotunes DOWN when the
+        per-coalition HBM footprint (params x (1 global + slots trained in
+        flight + adam moments + grads) plus the eval-chunk activation
+        window) would overflow ~50% of device memory. Override with
+        MPLC_TPU_COALITIONS_PER_DEVICE (a malformed value warns and falls
+        back to the autotune instead of crashing mid-sweep).
         """
-        env = os.environ.get("MPLC_TPU_COALITIONS_PER_DEVICE")
-        if env:
-            return max(1, int(env))
+        env_cap = constants._env_positive_int(
+            "MPLC_TPU_COALITIONS_PER_DEVICE", 0)
+        if env_cap:
+            return env_cap
         if getattr(self, "_param_bytes", None) is None:
             shapes = jax.eval_shape(self.model.init, jax.random.PRNGKey(0))
             self._param_bytes = sum(
@@ -381,19 +483,25 @@ class CharacteristicEngine:
         per_coal += 8 * sample_bytes * max(
             constants.EVAL_CHUNK_SIZE,
             self.stacked.x.shape[1] // max(1, self.multi_pipe.trainer.cfg.minibatch_count))
-        try:
-            stats = jax.local_devices()[0].memory_stats()
-            hbm = int(stats.get("bytes_limit", 8 << 30))
-        except Exception:
-            hbm = 8 << 30
-        fit = max(1, int(0.5 * hbm / max(per_coal, 1)))
+        if getattr(self, "_hbm_bytes", None) is None:
+            # one device query per engine, not one per _run_batch call —
+            # memory_stats crosses the tunnel on remote backends
+            try:
+                stats = jax.local_devices()[0].memory_stats()
+                self._hbm_bytes = int(stats.get("bytes_limit", 8 << 30))
+            except Exception:
+                self._hbm_bytes = 8 << 30
+        fit = max(1, int(0.5 * self._hbm_bytes / max(per_coal, 1)))
         if overlap:
             # two batches genuinely in flight — halve the memory-derived
             # cap (the explicit env override above is left to the operator;
-            # on a chip where the constant MAX binds instead of memory, as
-            # on v5e with the tiny sweep models, this changes nothing)
+            # on a chip where the ceiling binds instead of memory, as on
+            # v5e with the tiny sweep models, this changes nothing)
             fit = max(1, fit // 2)
-        return min(constants.MAX_COALITIONS_PER_DEVICE_BATCH, fit)
+        ceiling = constants._env_positive_int(
+            constants.BATCH_CAP_CEILING_ENV,
+            constants.MAX_COALITIONS_PER_DEVICE_BATCH)
+        return min(ceiling, fit)
 
     def _slot_pipe(self, k: int) -> BatchedTrainerPipeline:
         if k not in self._slot_pipes:
@@ -401,6 +509,15 @@ class CharacteristicEngine:
             self._slot_pipes[k] = BatchedTrainerPipeline(
                 MplTrainer.get(self.model, cfg), self.partners_count)
         return self._slot_pipes[k]
+
+    def _singles_pipe(self, b: int) -> BatchedTrainerPipeline:
+        """2-D-mode singles pipeline for bucket width `b`, cached so
+        repeated `_run_singles_sliced` calls (IS/MC estimators re-request
+        singles every block) stop re-wrapping the trainer per call."""
+        if b not in self._singles_pipes:
+            self._singles_pipes[b] = BatchedTrainerPipeline(
+                self.single_pipe.trainer, b)
+        return self._singles_pipes[b]
 
     def _run_batch(self, subsets: list[tuple], pipe,
                    slot_count: int | None = None) -> None:
@@ -425,27 +542,31 @@ class CharacteristicEngine:
                        if pipe is self.single_pipe
                        else self._epoch_samples_multi)
 
+        # Whole-call host prep, once per bucket instead of once per batch:
+        # one NumPy scatter builds every coalition row and every rng fold
+        # word; per-batch work below shrinks to an index select + one
+        # vmapped fold — the host-side share of the dispatch gap.
+        with obs_trace.span("engine.prep", coalitions=len(subsets),
+                            width=b, slot_count=slot_count):
+            coal_all = self._coalition_arrays(subsets, slot_count)
+            words, n_words = self._rng_fold_words(subsets)
+
         pending = None  # (group, fetch-thunk, remaining-after, meta) in flight
         try:
             i = 0
             while i < len(subsets):
                 group = subsets[i:i + b]
+                # padding rows replicate the batch's first coalition (the
+                # same convention the old per-batch fill loop used)
+                sel = np.full(b, i, np.intp)
+                sel[:len(group)] = np.arange(i, i + len(group))
                 i += len(group)
                 attrs = {"width": b, "slot_count": slot_count,
                          "coalitions": len(group), "padding": b - len(group)}
                 meta = {**attrs, "t0": time.perf_counter()}
                 with obs_trace.span("engine.dispatch", **attrs):
-                    padded = list(group) + [group[0]] * (b - len(group))
-                    if slot_count is not None:
-                        coal = np.full((b, slot_count), -1, np.int32)
-                        for j, s in enumerate(padded):
-                            coal[j, :len(s)] = sorted(s)
-                    else:
-                        coal = np.zeros((b, self.partners_count), np.float32)
-                        for j, s in enumerate(padded):
-                            coal[j, list(s)] = 1.0
-                    rngs = jnp.stack([self._coalition_rng(s) for s in padded])
-                    coal = jnp.asarray(coal)
+                    rngs = self._batch_rngs(words, n_words, sel)
+                    coal = jnp.asarray(coal_all[sel])
                     if getattr(pipe, "batch_sharding", None) is not None:
                         coal = jax.device_put(coal, pipe.batch_sharding)
                         rngs = jax.device_put(rngs, pipe.rng_sharding)
@@ -525,40 +646,67 @@ class CharacteristicEngine:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         n_dev = self._pipe2d.coal_devices
-        cap = self._device_batch_cap(1)
+        pipe_overlap = (self._pipeline_batches
+                        and self.single_pipe.dispatches_async)
+        cap = self._device_batch_cap(1, pipe_overlap)
         b = _bucket_size(min(len(singles), n_dev * cap), n_dev, cap)
         coal_sh = NamedSharding(self._pipe2d.mesh, P("coal"))
         rep_sh = NamedSharding(self._pipe2d.mesh, P())
-        pipe = BatchedTrainerPipeline(self.single_pipe.trainer, b)
+        pipe = self._singles_pipe(b)
+        overlap = self._pipeline_batches and pipe.dispatches_async
         # NOTE: the bucket/pad loop below mirrors _run_batch (which can't
         # be reused directly: the data tensor varies per batch here); the
         # per-batch bookkeeping is shared via _record_group. Keep the two
-        # pad loops in step when changing either. Sequential harvest (no
-        # pipelining): the per-batch data slice must be rebuilt host-side
-        # anyway, so overlap buys little here and singles are one batch in
-        # almost every real sweep.
-        i = 0
-        while i < len(singles):
-            group = singles[i:i + b]
-            i += len(group)
-            attrs = {"width": b, "slot_count": None,
-                     "coalitions": len(group), "padding": b - len(group)}
-            meta = {**attrs, "t0": time.perf_counter()}
-            with obs_trace.span("engine.dispatch", **attrs):
-                padded = list(group) + [group[0]] * (b - len(group))
-                ids = np.asarray([s[0] for s in padded], np.int32)
-                sliced = StackedPartners(
-                    x=jax.device_put(jnp.take(self.stacked.x, ids, axis=0), rep_sh),
-                    y=jax.device_put(jnp.take(self.stacked.y, ids, axis=0), rep_sh),
-                    mask=jax.device_put(jnp.take(self.stacked.mask, ids, axis=0), rep_sh),
-                    sizes=jax.device_put(jnp.take(self.stacked.sizes, ids, axis=0), rep_sh))
-                coal = jax.device_put(jnp.eye(b, dtype=jnp.float32), coal_sh)
-                rngs = jax.device_put(
-                    jnp.stack([self._coalition_rng(s) for s in padded]), coal_sh)
-                fetch = pipe.scores_async(coal, rngs, sliced, self.val, self.test,
-                                          self._coalition_rng(()))
-            self._record_group(group, fetch, len(singles) - i, meta,
-                               self._epoch_samples_single, None)
+        # pad loops in step when changing either. The per-batch host-side
+        # data-slice rebuild is exactly the dispatch gap batch pipelining
+        # hides, so the overlap applies here too (same pending/drain
+        # protocol as _run_batch).
+        with obs_trace.span("engine.prep", coalitions=len(singles),
+                            width=b, slot_count=None):
+            words, n_words = self._rng_fold_words(singles)
+            ids_all = np.fromiter((s[0] for s in singles), np.int32,
+                                  len(singles))
+            # the identity coalition mask is batch-invariant: build and
+            # place it once per call, not once per batch
+            eye = jax.device_put(jnp.eye(b, dtype=jnp.float32), coal_sh)
+        pending = None
+        try:
+            i = 0
+            while i < len(singles):
+                group = singles[i:i + b]
+                sel = np.full(b, i, np.intp)
+                sel[:len(group)] = np.arange(i, i + len(group))
+                i += len(group)
+                attrs = {"width": b, "slot_count": None,
+                         "coalitions": len(group), "padding": b - len(group)}
+                meta = {**attrs, "t0": time.perf_counter()}
+                with obs_trace.span("engine.dispatch", **attrs):
+                    ids = ids_all[sel]
+                    sliced = StackedPartners(
+                        x=jax.device_put(jnp.take(self.stacked.x, ids, axis=0), rep_sh),
+                        y=jax.device_put(jnp.take(self.stacked.y, ids, axis=0), rep_sh),
+                        mask=jax.device_put(jnp.take(self.stacked.mask, ids, axis=0), rep_sh),
+                        sizes=jax.device_put(jnp.take(self.stacked.sizes, ids, axis=0), rep_sh))
+                    rngs = jax.device_put(
+                        self._batch_rngs(words, n_words, sel), coal_sh)
+                    fetch = pipe.scores_async(eye, rngs, sliced, self.val,
+                                              self.test,
+                                              self._coalition_rng(()))
+                if overlap:
+                    if pending is not None:
+                        prev, pending = pending, None
+                        self._record_group(*prev, self._epoch_samples_single,
+                                           None)
+                    pending = (group, fetch, len(singles) - i, meta)
+                else:
+                    self._record_group(group, fetch, len(singles) - i, meta,
+                                       self._epoch_samples_single, None)
+        finally:
+            if pending is not None:
+                # same drain contract as _run_batch: harvest-on-exit, never
+                # re-harvest a batch whose fetch already raised
+                prev, pending = pending, None
+                self._record_group(*prev, self._epoch_samples_single, None)
 
     def _store(self, subset: tuple, value: float) -> None:
         self.charac_fct_values[subset] = value
@@ -610,29 +758,42 @@ class CharacteristicEngine:
                     self._run_batch(multis, self.multi_pipe)
         return np.array([self.charac_fct_values[k] for k in keys])
 
+    def _slot_width(self, k: int) -> int:
+        """Slot-program width a size-k coalition runs at under the active
+        bucketing mode (exact / merge / pow2). bench._warm_engine mirrors
+        the sweep's program set through this, so keep it the single source
+        of the width rule."""
+        if self._slot_pow2:
+            return min(1 << (k - 1).bit_length(), self.partners_count)
+        if self._slot_merge:
+            # adjacent sizes pair up: even k rides size k+1's program, so
+            # P-1 per-size programs become ceil((P-1)/2) and the even
+            # size's coalitions fill batch rows the odd size would have
+            # padded
+            return min(k + (k % 2 == 0), self.partners_count)
+        return k
+
     def _slot_buckets(self, multis: list[tuple]) -> list[tuple[int, list[tuple]]]:
         """Group coalitions by slot width.
 
-        Default: one tight group per coalition size — a size-k group trains
-        exactly k slots, no padded compute (fastest steady-state on chip).
-        With MPLC_TPU_SLOT_POW2=1, sizes round UP to the next power of two
-        (capped at the partner count), so a 10-partner sweep compiles ~4
-        slot pipelines (k in {2,4,8,10}) instead of 9: trades padded-slot
-        compute (inactive slots still run their pass) for roughly half the
-        cold-compile time. The trainer's -1 = unused-slot convention makes
-        mixed sizes inside one bucket exact, not approximate (active mask
-        zeroes the aggregation weight; rng keyed by global partner id).
-        Measure both modes on chip before picking one for a long sweep."""
-        pow2 = self._slot_pow2
-
-        def width(n: int) -> int:
-            if not pow2:
-                return n
-            return min(1 << (n - 1).bit_length(), self.partners_count)
-
+        Default (`merge`): adjacent coalition sizes share one width — size
+        k and k+1 (even k merging up) run as ONE batch stream at width
+        k+1, so a 10-partner sweep compiles 5 slot programs instead of 9
+        and the smaller size's tail fills padding rows of the larger
+        size's batches. Costs at most one padded slot of compute per
+        merged coalition. MPLC_TPU_SLOT_MERGE=0 restores the tight
+        per-size grouping (`exact`: zero padded slot compute, most
+        programs — fastest steady-state with a warm compile cache).
+        MPLC_TPU_SLOT_POW2=1 rounds sizes UP to the next power of two
+        (capped at the partner count): ~log2(P) programs, the cheapest
+        cold start, the most padded compute. All three produce identical
+        v(S): the trainer's -1 = unused-slot convention makes mixed sizes
+        inside one bucket exact, not approximate (active mask zeroes the
+        aggregation weight; rng keyed by global partner id —
+        equality-tested across modes)."""
         by_width: dict[int, list[tuple]] = {}
         for s in multis:
-            by_width.setdefault(width(len(s)), []).append(s)
+            by_width.setdefault(self._slot_width(len(s)), []).append(s)
         return [(w, by_width[w]) for w in sorted(by_width)]
 
     def not_twice_characteristic(self, subset) -> float:

@@ -5,9 +5,12 @@ sidecar and a human-readable summary.
 run (`obs.trace.collect()`, or a parsed JSONL trace file) and derives the
 quantities every perf PR needs as a measured before/after:
 
-  - wall-clock split: compile vs dispatch vs harvest inside the engine's
-    evaluate() time (compile happens *inside* the first dispatch/harvest of
-    each program, so the three components are reported raw, not disjoint);
+  - wall-clock split: compile vs prep vs dispatch vs harvest inside the
+    engine's evaluate() time (compile happens *inside* the first
+    dispatch/harvest of each program, so the components are reported raw,
+    not disjoint); `prep` is the whole-call host-side batch construction —
+    coalition arrays, rng fold words, batch-invariant device placements —
+    done once per bucket before its dispatch loop;
   - memo hit/miss counts and hit rate (from engine.evaluate span attrs);
   - padding waste: padded slots / total batch slots over the whole run;
   - per-(slot_count, width) bucket throughput: coalitions and epochs per
@@ -32,7 +35,7 @@ def _attrs(rec: dict) -> dict:
 
 def sweep_report(records: list, metrics_snapshot: dict | None = None) -> dict:
     """Aggregate a list of trace records (dicts) into the sweep report."""
-    evaluate_s = dispatch_s = harvest_s = compile_s = 0.0
+    evaluate_s = prep_s = dispatch_s = harvest_s = compile_s = 0.0
     requested = missing = 0
     compiles: dict = {}
     buckets: dict = {}
@@ -48,6 +51,8 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None) -> dict:
             evaluate_s += dur
             requested += int(a.get("requested", 0))
             missing += int(a.get("missing", 0))
+        elif name == "engine.prep":
+            prep_s += dur
         elif name == "engine.dispatch":
             dispatch_s += dur
         elif name == "engine.harvest":
@@ -94,6 +99,7 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None) -> dict:
         "wallclock": {
             "evaluate_s": evaluate_s,
             "compile_s": compile_s,
+            "prep_s": prep_s,
             "dispatch_s": dispatch_s,
             "harvest_s": harvest_s,
         },
@@ -129,7 +135,8 @@ def format_report(report: dict) -> str:
     lines = ["sweep report:"]
     lines.append(
         f"  wall-clock  evaluate={w['evaluate_s']:.2f}s  "
-        f"compile={w['compile_s']:.2f}s  dispatch={w['dispatch_s']:.2f}s  "
+        f"compile={w['compile_s']:.2f}s  prep={w.get('prep_s', 0.0):.2f}s  "
+        f"dispatch={w['dispatch_s']:.2f}s  "
         f"harvest={w['harvest_s']:.2f}s")
     hr = m["hit_rate"]
     lines.append(

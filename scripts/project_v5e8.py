@@ -21,7 +21,13 @@ measurement:
 Usage:
   python scripts/project_v5e8.py [--log perf/r4/config1.log]
       [--curve perf/r5/width_curve.log] [--ndev 8] [--cap 16]
-      [--partners 10] [--pow2]
+      [--partners 10] [--pow2 | --merge]
+      [--telemetry perf/telemetry_config1.json]
+
+--log also accepts a structured JSONL trace (MPLC_TPU_TRACE_FILE): batch
+durations then come from measured engine.batch spans instead of progress-
+line differencing, and --telemetry prints a sweep's measured
+prep/dispatch/harvest split (the engine.prep row) next to the projection.
 """
 
 import argparse
@@ -74,14 +80,85 @@ def _call_groups(rows):
         prev_order = order
 
 
-def parse_batch_times(log_path):
-    """Per-slot-size batch durations (s) from the timed progress lines.
+def parse_trace_records(path):
+    """Records from a structured JSONL trace (MPLC_TPU_TRACE_FILE);
+    malformed lines (a truncated tail from a wedge mid-write) are
+    skipped, not fatal."""
+    import json
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue
+    return records
 
-    Returns {slot_count_or_None: [durations]}. All batches of one
-    evaluate() call share one bucket width. prev_t resets at evaluate()
-    boundaries: the first batch after a boundary absorbs inter-call
-    host/compile time, so its duration is unknowable from the log and it
-    contributes no sample (ADVICE r5)."""
+
+def parse_trace_batch_times(path):
+    """{slot_count_or_None: [durations]} from a JSONL trace's engine.batch
+    events. Strictly better input than the progress-line deltas: each
+    event's `dur` is a measured dispatch-start -> harvest-end span, so no
+    prev_t differencing — and therefore no reset-at-boundary rule — is
+    needed; cross-evaluate host gaps (estimator code, Kriging refits) can
+    never pollute a cell by construction. Under batch pipelining
+    consecutive spans overlap (a utilization view), which medians absorb
+    the same way they absorb residual-compile outliers."""
+    times = {}
+    for rec in parse_trace_records(path):
+        if rec.get("name") != "engine.batch":
+            continue
+        a = rec.get("attrs") or {}
+        slots = a.get("slot_count")
+        times.setdefault(slots, []).append(float(rec.get("dur") or 0.0))
+    return times
+
+
+def parse_trace_split(path):
+    """The prep/dispatch/harvest wall-clock split summed from a JSONL
+    trace — the measured view of the host-side dispatch gap the sweep
+    fusion work attacks."""
+    split = {"evaluate_s": 0.0, "prep_s": 0.0, "dispatch_s": 0.0,
+             "harvest_s": 0.0}
+    for rec in parse_trace_records(path):
+        key = {"engine.evaluate": "evaluate_s", "engine.prep": "prep_s",
+               "engine.dispatch": "dispatch_s",
+               "engine.harvest": "harvest_s"}.get(rec.get("name"))
+        if key:
+            split[key] += float(rec.get("dur") or 0.0)
+    return split
+
+
+def load_telemetry_split(path):
+    """The wall-clock split from a bench telemetry sidecar
+    (perf/telemetry_config<N>.json). Pre-prep-span sidecars (older report
+    schema) load with prep_s = 0 rather than failing."""
+    import json
+    with open(path) as f:
+        rec = json.load(f)
+    w = dict(rec.get("report", {}).get("wallclock", {}))
+    w.setdefault("prep_s", 0.0)
+    return w
+
+
+def parse_batch_times(log_path):
+    """Per-slot-size batch durations (s), from either input kind:
+
+    - a `*.jsonl` structured trace -> parse_trace_batch_times (measured
+      per-batch spans, no differencing);
+    - a bench stderr log -> the timed progress lines below. All batches of
+      one evaluate() call share one bucket width. prev_t resets at
+      evaluate() boundaries: the first batch after a boundary absorbs
+      inter-call host/compile time, so its duration is unknowable from the
+      log and it contributes no sample (ADVICE r5)."""
+    if str(log_path).endswith(".jsonl"):
+        times = parse_trace_batch_times(log_path)
+        if not times:
+            raise SystemExit(f"no engine.batch events in {log_path}")
+        return times
     rows = parse_timed_rows(log_path)
     if not rows:
         raise SystemExit(f"no timed progress lines in {log_path}")
@@ -188,10 +265,11 @@ def fit_affine(pts):
     return a, c
 
 
-def schedule(n_partners, n_dev, cap, pow2):
+def schedule(n_partners, n_dev, cap, pow2, merge=False):
     """The 8-device bucket schedule: [(slot_width, batch_width, count)].
     Mirrors engine.evaluate: singles in one call, then one call per slot
-    bucket (per size, or per pow2-width group)."""
+    bucket (per size, per merged adjacent-size pair, or per pow2-width
+    group — engine._slot_width)."""
     out = []
     b = bucket_size(min(n_partners, n_dev * cap), n_dev, cap)
     out.append((1, b, math.ceil(n_partners / b)))
@@ -199,6 +277,11 @@ def schedule(n_partners, n_dev, cap, pow2):
         groups = {}
         for k in range(2, n_partners + 1):
             w = min(1 << (k - 1).bit_length(), n_partners)
+            groups[w] = groups.get(w, 0) + comb(n_partners, k)
+    elif merge:
+        groups = {}
+        for k in range(2, n_partners + 1):
+            w = min(k + (k % 2 == 0), n_partners)
             groups[w] = groups.get(w, 0) + comb(n_partners, k)
     else:
         groups = {k: comb(n_partners, k) for k in range(2, n_partners + 1)}
@@ -223,7 +306,26 @@ def main():
     ap.add_argument("--cap", type=int, default=16)
     ap.add_argument("--partners", type=int, default=10)
     ap.add_argument("--pow2", action="store_true")
+    ap.add_argument("--merge", action="store_true",
+                    help="schedule with merged adjacent slot sizes "
+                         "(MPLC_TPU_SLOT_MERGE, the engine default)")
+    ap.add_argument("--telemetry", default="",
+                    help="bench telemetry sidecar (telemetry_config<N>.json)"
+                         " — prints the measured prep/dispatch/harvest split")
     args = ap.parse_args()
+
+    if args.telemetry:
+        if not os.path.exists(args.telemetry):
+            raise SystemExit(f"no telemetry sidecar at {args.telemetry}")
+        w = load_telemetry_split(args.telemetry)
+        gap = w.get("evaluate_s", 0.0) - w["prep_s"] \
+            - w.get("dispatch_s", 0.0) - w.get("harvest_s", 0.0)
+        print(f"measured split {args.telemetry}: "
+              f"evaluate={w.get('evaluate_s', 0.0):.1f}s "
+              f"prep={w['prep_s']:.1f}s "
+              f"dispatch={w.get('dispatch_s', 0.0):.1f}s "
+              f"harvest={w.get('harvest_s', 0.0):.1f}s "
+              f"(other host gap ~{gap:.1f}s)\n")
 
     times = parse_batch_times(args.log)
 
@@ -269,8 +371,9 @@ def main():
     models["linear(optimistic)"] = lambda w: w / 16.0
     models["flat(pessimistic)"] = lambda w: 1.0
 
-    sched = schedule(args.partners, args.ndev, args.cap, args.pow2)
-    mode = "pow2" if args.pow2 else "per-size"
+    sched = schedule(args.partners, args.ndev, args.cap, args.pow2,
+                     merge=args.merge)
+    mode = "pow2" if args.pow2 else "merge" if args.merge else "per-size"
     print(f"\nschedule ({mode}, ndev={args.ndev}, cap={args.cap}): "
           f"(slot_width, batch_width, n_batches) = {sched}")
 

@@ -38,7 +38,8 @@ def main():
     # knobs would silently change what is being timed (the sharding tests
     # delenv these for the same reason)
     for knob in ("MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_NO_SLOTS",
-                 "MPLC_TPU_SLOT_POW2"):
+                 "MPLC_TPU_SLOT_POW2", "MPLC_TPU_SLOT_MERGE",
+                 "MPLC_TPU_PIPELINE_BATCHES", "MPLC_TPU_BATCH_CAP_CEILING"):
         if os.environ.pop(knob, None) is not None:
             print(f"[tune] ignoring ambient {knob}", file=sys.stderr)
 

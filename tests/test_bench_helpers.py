@@ -94,9 +94,11 @@ def test_fallback_env_strip_covers_workload_knobs():
     src_replay = inspect.getsource(bench._replay_cached_tpu_result)
     src_spawn = inspect.getsource(bench._spawn_cpu_fallback)
     for knob in ("MPLC_TPU_EVAL_CHUNK", "BENCH_DTYPE",
+                 "MPLC_TPU_BATCH_CAP_CEILING",
                  "MPLC_TPU_COALITIONS_PER_DEVICE", "MPLC_TPU_NO_SLOTS",
                  "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
-                 "MPLC_TPU_SLOT_POW2", "MPLC_TPU_SYNTH_SCALE"):
+                 "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
+                 "MPLC_TPU_SYNTH_SCALE"):
         assert knob in src_replay, f"{knob} missing from replay refusal"
         assert knob in src_spawn, f"{knob} missing from fallback env strip"
 
@@ -170,7 +172,8 @@ def test_replay_emits_newest_valid_record(tmp_path, monkeypatch, capsys):
     for knob in ("BENCH_CONFIG", "BENCH_PARTNERS", "BENCH_EPOCHS",
                  "BENCH_DATASET", "BENCH_METRIC_SUFFIX", "BENCH_DTYPE",
                  "MPLC_TPU_SYNTH_SCALE", "MPLC_TPU_SLOT_POW2",
-                 "MPLC_TPU_EVAL_CHUNK"):
+                 "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_BATCH_CAP_CEILING",
+                 "MPLC_TPU_PIPELINE_BATCHES", "MPLC_TPU_EVAL_CHUNK"):
         monkeypatch.delenv(knob, raising=False)
     old = _write_record(tmp_path, "r4",
                         "exact_shapley_mnist_10partners_8epochs_wallclock",
@@ -201,6 +204,7 @@ def test_replay_refuses_nondefault_workloads(tmp_path, monkeypatch, capsys):
     for knob in ("BENCH_CONFIG", "BENCH_PARTNERS", "BENCH_EPOCHS",
                  "BENCH_DATASET", "BENCH_METRIC_SUFFIX", "BENCH_DTYPE",
                  "MPLC_TPU_SYNTH_SCALE", "MPLC_TPU_SLOT_POW2",
+                 "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_BATCH_CAP_CEILING",
                  "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
                  "MPLC_TPU_COALITIONS_PER_DEVICE", "MPLC_TPU_EVAL_CHUNK",
                  "MPLC_TPU_PIPELINE_BATCHES"):
@@ -213,7 +217,12 @@ def test_replay_refuses_nondefault_workloads(tmp_path, monkeypatch, capsys):
                       # program + the memory-derived batch cap: a cached
                       # default-workload number must not be replayed for it
                       ("MPLC_TPU_EVAL_CHUNK", "1024"),
-                      ("MPLC_TPU_PIPELINE_BATCHES", "1"),
+                      # opting OUT of the defaults is also a different
+                      # workload: the sequential-harvest and per-size
+                      # bucketing engines run other programs/schedules
+                      ("MPLC_TPU_PIPELINE_BATCHES", "0"),
+                      ("MPLC_TPU_SLOT_MERGE", "0"),
+                      ("MPLC_TPU_BATCH_CAP_CEILING", "32"),
                       ("BENCH_METRIC_SUFFIX", "_x")):
         monkeypatch.setenv(knob, bad)
         assert bench._replay_cached_tpu_result(str(tmp_path)) is False, knob
@@ -229,8 +238,10 @@ def test_replay_skips_malformed_records(tmp_path, monkeypatch, capsys):
     for knob in ("BENCH_CONFIG", "BENCH_PARTNERS", "BENCH_EPOCHS",
                  "BENCH_DATASET", "BENCH_METRIC_SUFFIX", "BENCH_DTYPE",
                  "MPLC_TPU_SYNTH_SCALE", "MPLC_TPU_SLOT_POW2",
+                 "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_BATCH_CAP_CEILING",
                  "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
-                 "MPLC_TPU_COALITIONS_PER_DEVICE", "MPLC_TPU_EVAL_CHUNK"):
+                 "MPLC_TPU_COALITIONS_PER_DEVICE", "MPLC_TPU_EVAL_CHUNK",
+                 "MPLC_TPU_PIPELINE_BATCHES"):
         # the tests' conftest sets MPLC_TPU_SYNTH_SCALE ambiently — the
         # gate must see the driver's clean default env here
         monkeypatch.delenv(knob, raising=False)

@@ -1,0 +1,311 @@
+"""Sweep-level dispatch fusion (ISSUE 2): the vectorized batch prep, the
+merged slot bucketing, the default-on batch pipelining and the batch-cap
+plumbing.
+
+The contract under test: every fused path produces BIT-IDENTICAL v(S)
+values to the path it replaced — the per-subset rng fold, the per-batch
+Python fill loops, the per-size slot programs and the sequential harvest
+are pure dispatch-shape changes, never numerics changes — while compiling
+fewer programs and padding fewer batch rows.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+import jax
+
+from mplc_tpu.contrib.engine import (BatchedTrainerPipeline,
+                                     CharacteristicEngine)
+from mplc_tpu.contrib.shapley import powerset_order
+
+
+def _scenario(n=5, **kw):
+    from helpers import build_scenario
+    amounts = [(i + 1) / (n * (n + 1) / 2) for i in range(n)]
+    params = dict(partners_count=n, amounts_per_partner=amounts,
+                  dataset_name="titanic", epoch_count=2,
+                  gradient_updates_per_pass_count=2, seed=11)
+    params.update(kw)
+    return build_scenario(**params)
+
+
+# -- vectorized rng fold -----------------------------------------------------
+
+def _rng_dummy(partners_count, seed=7):
+    """The rng helpers only touch seed / partners_count / the cached seed
+    key — a bare namespace exercises them without building an engine."""
+    return types.SimpleNamespace(
+        seed=seed, partners_count=partners_count,
+        _rng_word_count=max(1, (partners_count + 31) // 32),
+        _seed_key=jax.random.PRNGKey(seed))
+
+
+def _batch_keys(dummy, subsets):
+    words, n_words = CharacteristicEngine._rng_fold_words(dummy, subsets)
+    sel = np.arange(len(subsets))
+    return np.asarray(
+        CharacteristicEngine._batch_rngs(dummy, words, n_words, sel))
+
+
+def test_vectorized_rng_fold_matches_scalar_loop():
+    """The jitted vmapped fold must reproduce _coalition_rng's key stream
+    bit-for-bit — same coalition, same training — for every subset shape,
+    including the empty tuple the base rng uses."""
+    dummy = _rng_dummy(10)
+    subsets = [(), (0,), (9,), (0, 1), (2, 5, 7), tuple(range(10))]
+    keys = _batch_keys(dummy, subsets)
+    for k, s in zip(keys, subsets):
+        np.testing.assert_array_equal(
+            k, np.asarray(CharacteristicEngine._coalition_rng(dummy, s)), s)
+
+
+def test_vectorized_rng_fold_matches_past_32_partners():
+    """>= 32 partners folds the membership bitmask in MULTIPLE uint32
+    words, and the scalar loop folds only up to the highest non-zero word
+    — a subset of low indices folds ONCE even at 40 partners. The
+    vectorized fold must reproduce both the word packing and the variable
+    fold count exactly."""
+    dummy = _rng_dummy(40)
+    subsets = [(), (0,), (31,), (32,), (39,), (5, 33), (0, 31, 32, 39),
+               (38, 39), tuple(range(40))]
+    words, n_words = CharacteristicEngine._rng_fold_words(dummy, subsets)
+    assert words.shape == (len(subsets), 2)
+    # low-index subsets fold once; any index >= 32 forces the second word
+    by_subset = dict(zip(subsets, n_words))
+    assert by_subset[(31,)] == 1 and by_subset[(0,)] == 1
+    assert by_subset[(32,)] == 2 and by_subset[(5, 33)] == 2
+    assert by_subset[()] == 1
+    keys = _batch_keys(dummy, subsets)
+    for k, s in zip(keys, subsets):
+        np.testing.assert_array_equal(
+            k, np.asarray(CharacteristicEngine._coalition_rng(dummy, s)), s)
+    # distinct subsets must still get distinct streams
+    assert len({tuple(k) for k in keys}) == len(subsets)
+
+
+def test_coalition_array_scatter_matches_fill_loop():
+    """The whole-call NumPy scatter must equal the old per-row fill loops
+    for both the slot-id and the mask layout."""
+    dummy = types.SimpleNamespace(partners_count=6)
+    subsets = [(0, 3), (1, 2, 5), (4,), (0, 1, 2, 3, 4, 5)]
+    ids = CharacteristicEngine._coalition_arrays(dummy, subsets, 6)
+    masks = CharacteristicEngine._coalition_arrays(dummy, subsets, None)
+    for j, s in enumerate(subsets):
+        ref_ids = np.full(6, -1, np.int32)
+        ref_ids[:len(s)] = sorted(s)
+        np.testing.assert_array_equal(ids[j], ref_ids)
+        ref_mask = np.zeros(6, np.float32)
+        ref_mask[list(s)] = 1.0
+        np.testing.assert_array_equal(masks[j], ref_mask)
+
+
+# -- slot-merge bucketing ----------------------------------------------------
+
+def test_slot_merge_is_default_and_pairs_adjacent_sizes(monkeypatch):
+    monkeypatch.delenv("MPLC_TPU_SLOT_MERGE", raising=False)
+    monkeypatch.delenv("MPLC_TPU_SLOT_POW2", raising=False)
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    sc = _scenario(5)
+    eng = CharacteristicEngine(sc)
+    assert eng._slot_merge and sc.slot_bucketing == "merge"
+    # even sizes ride the next odd size's program, capped at P
+    assert [eng._slot_width(k) for k in range(2, 6)] == [3, 3, 5, 5]
+    # a 10-partner sweep plans ceil(9/2) = 5 programs instead of 9
+    eng.partners_count = 10
+    assert sorted({eng._slot_width(k) for k in range(2, 11)}) == \
+        [3, 5, 7, 9, 10]
+
+
+def test_slot_merge_bit_identical_to_exact_pow2_and_masked(monkeypatch):
+    """The acceptance contract: the full 5-partner v(S) table is
+    bit-identical across masked / exact / pow2 / merge execution — the -1
+    unused-slot convention plus global-partner-id rng keying make mixed
+    widths exact, and inactive slots contribute exactly-zero aggregation
+    weight."""
+    subsets = powerset_order(5)
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    monkeypatch.delenv("MPLC_TPU_SLOT_POW2", raising=False)
+
+    monkeypatch.setenv("MPLC_TPU_SLOT_MERGE", "0")
+    exact_eng = CharacteristicEngine(_scenario(5))
+    assert exact_eng.scenario.slot_bucketing == "exact"
+    exact = exact_eng.evaluate(subsets)
+
+    monkeypatch.delenv("MPLC_TPU_SLOT_MERGE", raising=False)
+    merge_eng = CharacteristicEngine(_scenario(5))
+    merge = merge_eng.evaluate(subsets)
+    # sizes (2,3) share the 3-slot program, (4,5) the 5-slot one
+    assert sorted(merge_eng._slot_pipes) == [3, 5]
+    np.testing.assert_array_equal(merge, exact)
+
+    monkeypatch.setenv("MPLC_TPU_SLOT_POW2", "1")
+    pow2 = CharacteristicEngine(_scenario(5)).evaluate(subsets)
+    np.testing.assert_array_equal(pow2, exact)
+
+    monkeypatch.delenv("MPLC_TPU_SLOT_POW2", raising=False)
+    monkeypatch.setenv("MPLC_TPU_NO_SLOTS", "1")
+    masked_eng = CharacteristicEngine(_scenario(5))
+    assert masked_eng.scenario.slot_bucketing == "masked"
+    masked = masked_eng.evaluate(subsets)
+    np.testing.assert_array_equal(masked, exact)
+
+    # the table must discriminate, or the equality contract is vacuous
+    assert exact.max() - exact.min() > 1e-3
+
+
+def test_merge_mode_compiles_fewer_programs_and_pads_less(monkeypatch):
+    """The obs-metrics regression of the acceptance criteria: on a
+    synthetic 10-partner full sweep (CPU mesh, cap=2 -> the width-16
+    batches of the single-chip cap-16 regime), merge mode runs <= 5 slot
+    programs (vs 9 exact) and records strictly lower summed batch padding.
+    Training is stubbed out — the engine's real scheduling, padding and
+    accounting run; only the device work is skipped."""
+    from mplc_tpu.obs import trace
+    from mplc_tpu.obs.report import sweep_report
+
+    def fake_scores_async(self, masks, rngs, stacked, val, test, base_rng):
+        b = int(masks.shape[0])
+        return lambda: (np.full(b, 0.5, np.float32),
+                        np.full(b, 2, np.int32))
+
+    monkeypatch.setattr(BatchedTrainerPipeline, "scores_async",
+                        fake_scores_async)
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "2")
+    monkeypatch.delenv("MPLC_TPU_SLOT_POW2", raising=False)
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    subsets = powerset_order(10)
+
+    def run(merge_env):
+        if merge_env is None:
+            monkeypatch.delenv("MPLC_TPU_SLOT_MERGE", raising=False)
+        else:
+            monkeypatch.setenv("MPLC_TPU_SLOT_MERGE", merge_env)
+        eng = CharacteristicEngine(_scenario(10))
+        with trace.collect() as recs:
+            eng.evaluate(subsets)
+        assert eng.first_charac_fct_calls_count == 1023
+        rep = sweep_report(recs)
+        programs = {(r["attrs"]["slot_count"], r["attrs"]["width"])
+                    for r in recs if r["name"] == "engine.batch"
+                    if r["attrs"]["slot_count"] is not None}
+        return eng, rep, programs
+
+    exact_eng, exact_rep, exact_programs = run("0")
+    merge_eng, merge_rep, merge_programs = run(None)
+
+    assert len(exact_eng._slot_pipes) == 9
+    assert len(merge_eng._slot_pipes) <= 5
+    assert len(merge_programs) <= 5 < len(exact_programs)
+    # every batch of every program runs at width 16 here except the lone
+    # size-10 coalition's — identical program SHAPE count, fewer programs
+    assert merge_rep["batches"]["padding"] < exact_rep["batches"]["padding"]
+    # both modes trained every coalition exactly once
+    assert merge_rep["batches"]["coalitions"] == \
+        exact_rep["batches"]["coalitions"] == 1023
+    # the pad-waste histogram mirrored the same totals
+    from mplc_tpu.obs import metrics
+    assert metrics.snapshot()["histograms"][
+        "engine.pad_waste_fraction"]["count"] > 0
+
+
+# -- pipelining defaults & the 2-D singles overlap ---------------------------
+
+def test_pipelining_is_default_on_with_opt_out(monkeypatch):
+    monkeypatch.delenv("MPLC_TPU_PIPELINE_BATCHES", raising=False)
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    eng = CharacteristicEngine(_scenario(3))
+    assert eng._pipeline_batches
+    monkeypatch.setenv("MPLC_TPU_PIPELINE_BATCHES", "0")
+    assert not CharacteristicEngine(_scenario(3))._pipeline_batches
+
+
+def test_pipelined_singles_sliced_matches_sequential(monkeypatch):
+    """The 2-D data-sliced singles path now overlaps batches too (its
+    host-side slice rebuild is exactly the gap overlap hides). Results
+    must be bit-identical to the sequential harvest, the cached
+    per-bucket-width pipeline must be reused across calls, and cap=1
+    forces 2 batches so the pending/drain protocol really runs."""
+    monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "2")
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
+    n = 8
+    singles = [(i,) for i in range(n)]
+
+    monkeypatch.setenv("MPLC_TPU_PIPELINE_BATCHES", "0")
+    ref_vals = CharacteristicEngine(_scenario(n)).evaluate(singles)
+
+    monkeypatch.delenv("MPLC_TPU_PIPELINE_BATCHES", raising=False)
+    eng = CharacteristicEngine(_scenario(n))
+    assert eng._pipe2d is not None
+    progressed = []
+    eng.progress = lambda done, rem, slots: progressed.append((done, rem))
+    vals = eng.evaluate(singles)
+    np.testing.assert_array_equal(vals, ref_vals)
+    # 8 singles over a 4-wide coal mesh at cap=1 = two width-4 batches,
+    # each drained exactly once
+    assert progressed == [(4, 4), (4, 0)]
+    # one cached pipeline, keyed by the bucket width
+    assert list(eng._singles_pipes) == [4]
+    pipe = eng._singles_pipes[4]
+    eng.charac_fct_values = {(): 0.0}  # force re-evaluation
+    eng.evaluate(singles)
+    assert eng._singles_pipes[4] is pipe  # reused, not rebuilt
+
+
+# -- batch-cap plumbing ------------------------------------------------------
+
+def test_malformed_cap_env_warns_and_falls_back(monkeypatch):
+    """A malformed MPLC_TPU_COALITIONS_PER_DEVICE must warn and fall back
+    to the autotune (same contract as MPLC_TPU_EVAL_CHUNK) instead of
+    crashing mid-sweep."""
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    eng = CharacteristicEngine(_scenario(3))
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "sixteen")
+    with pytest.warns(UserWarning, match="MPLC_TPU_COALITIONS_PER_DEVICE"):
+        cap = eng._device_batch_cap()
+    assert 1 <= cap <= 16
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "-3")
+    with pytest.warns(UserWarning):
+        assert 1 <= eng._device_batch_cap() <= 16
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "24")
+    assert eng._device_batch_cap() == 24
+
+
+def test_cap_ceiling_env_lifts_autotune_past_16(monkeypatch):
+    """MPLC_TPU_BATCH_CAP_CEILING lifts the constant ceiling the
+    HBM-derived autotune is clamped to (merge mode bounds the program
+    count, so wider buckets no longer multiply compiles by 9)."""
+    monkeypatch.delenv("MPLC_TPU_COALITIONS_PER_DEVICE", raising=False)
+    monkeypatch.delenv("MPLC_TPU_BATCH_CAP_CEILING", raising=False)
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    eng = CharacteristicEngine(_scenario(3))
+    eng._hbm_bytes = 1 << 50  # memory never binds: the ceiling does
+    assert eng._device_batch_cap() == 16
+    monkeypatch.setenv("MPLC_TPU_BATCH_CAP_CEILING", "64")
+    assert eng._device_batch_cap() == 64
+    # malformed ceiling falls back to the default 16, with a warning
+    monkeypatch.setenv("MPLC_TPU_BATCH_CAP_CEILING", "wide")
+    with pytest.warns(UserWarning, match="MPLC_TPU_BATCH_CAP_CEILING"):
+        assert eng._device_batch_cap() == 16
+
+
+def test_memory_stats_queried_once_per_engine(monkeypatch):
+    """_device_batch_cap caches the device memory limit: memory_stats
+    crosses the tunnel on remote backends and was being re-queried every
+    _run_batch call."""
+    monkeypatch.delenv("MPLC_TPU_COALITIONS_PER_DEVICE", raising=False)
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    eng = CharacteristicEngine(_scenario(3))
+    calls = {"n": 0}
+
+    class Dev:
+        def memory_stats(self):
+            calls["n"] += 1
+            return {"bytes_limit": 8 << 30}
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [Dev()])
+    first = eng._device_batch_cap()
+    for _ in range(5):
+        assert eng._device_batch_cap() == first
+    assert calls["n"] == 1

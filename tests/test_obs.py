@@ -206,6 +206,8 @@ def test_report_format_and_write(tmp_path):
     rep = report.sweep_report([
         {"name": "engine.evaluate", "dur": 2.0,
          "attrs": {"requested": 4, "missing": 1}},
+        {"name": "engine.prep", "dur": 0.25,
+         "attrs": {"width": 8, "slot_count": 2, "coalitions": 6}},
         {"name": "engine.batch", "dur": 1.5,
          "attrs": {"width": 8, "slot_count": 2, "coalitions": 6,
                    "padding": 2, "epochs": 24}},
@@ -213,11 +215,17 @@ def test_report_format_and_write(tmp_path):
     ])
     assert rep["memo"] == {"requested": 4, "hits": 3, "misses": 1,
                            "hit_rate": 0.75}
+    assert rep["wallclock"]["prep_s"] == 0.25
     assert rep["batches"]["pad_waste_fraction"] == 0.25
     assert rep["per_width"][0]["coalitions_per_s"] == 4.0
     text = report.format_report(rep)
     assert "hit_rate=75.0%" in text
     assert "pad_waste=25.0%" in text
+    assert "prep=0.25s" in text
+    # a report from an older run (no prep row recorded) still formats
+    old = dict(rep, wallclock={k: v for k, v in rep["wallclock"].items()
+                               if k != "prep_s"})
+    assert "prep=0.00s" in report.format_report(old)
     path = tmp_path / "rep.json"
     report.write_report(str(path), rep)
     assert json.loads(path.read_text())["memo"]["hits"] == 3
@@ -254,6 +262,7 @@ def test_engine_smoke_sweep_report(tmp_path, monkeypatch):
     assert eng.epochs_trained == 6
     # wall-clock split present; the cold engine compiled inside the region
     assert rep["wallclock"]["evaluate_s"] > 0
+    assert rep["wallclock"]["prep_s"] > 0
     assert rep["wallclock"]["dispatch_s"] > 0
     assert rep["wallclock"]["harvest_s"] > 0
     assert rep["compiles"], "cold sweep must record compile events"
@@ -270,8 +279,8 @@ def test_engine_smoke_sweep_report(tmp_path, monkeypatch):
     lines = (tmp_path / "trace.jsonl").read_text().strip().splitlines()
     parsed = [json.loads(l) for l in lines]
     names = {r["name"] for r in parsed}
-    assert {"engine.evaluate", "engine.dispatch", "engine.harvest",
-            "engine.batch"} <= names
+    assert {"engine.evaluate", "engine.prep", "engine.dispatch",
+            "engine.harvest", "engine.batch"} <= names
     # dispatch/harvest spans nest under their evaluate span
     ev_ids = {r["id"] for r in parsed if r["name"] == "engine.evaluate"}
     for r in parsed:

@@ -73,6 +73,86 @@ def test_schedule_reproduces_engine_bucket_plan():
         (6, 128, 2), (7, 128, 1), (8, 64, 1), (9, 16, 1), (10, 8, 1)]
     assert proj.schedule(10, 8, 16, pow2=True) == [
         (1, 16, 1), (2, 64, 1), (4, 128, 3), (8, 128, 5), (10, 16, 1)]
+    # the merged-adjacent-size plan (engine default, MPLC_TPU_SLOT_MERGE):
+    # 5 slot programs, the even size's tail filling the odd size's batches
+    assert proj.schedule(10, 8, 16, pow2=False, merge=True) == [
+        (1, 16, 1), (3, 128, 2), (5, 128, 4), (7, 128, 3), (9, 64, 1),
+        (10, 8, 1)]
+
+
+def test_schedule_merge_widths_match_engine_rule():
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+
+    class _E:
+        _slot_pow2 = False
+        _slot_merge = True
+
+    for n in (4, 5, 7, 10, 12):
+        _E.partners_count = n
+        eng_widths = {CharacteristicEngine._slot_width(_E, k)
+                      for k in range(2, n + 1)}
+        sched_widths = {w for w, _b, _n in
+                        proj.schedule(n, 8, 16, pow2=False, merge=True)
+                        if w > 1}
+        assert sched_widths == eng_widths, n
+
+
+def _trace_line(name, dur, **attrs):
+    import json
+    return json.dumps({"name": name, "id": 1, "parent": None, "ts": 0.0,
+                       "dur": dur, "thread": 1, "attrs": attrs})
+
+
+def test_trace_jsonl_batch_times_and_split(tmp_path):
+    """A structured JSONL trace feeds the projection directly: engine.batch
+    spans are measured durations, so cross-evaluate host gaps (the thing
+    the log parser's reset-at-boundary rule exists to excise) cannot
+    pollute any cell by construction — a batch recorded right after a long
+    estimator pause carries its own dur. Malformed tail lines (wedge
+    mid-write) are skipped."""
+    trace = tmp_path / "sweep_trace.jsonl"
+    lines = [
+        _trace_line("engine.evaluate", 100.0, requested=20, missing=20),
+        _trace_line("engine.prep", 0.5, width=16, slot_count=3),
+        _trace_line("engine.dispatch", 0.2, width=16, slot_count=3),
+        _trace_line("engine.batch", 31.0, width=16, slot_count=3,
+                    coalitions=16, padding=0, epochs=128),
+        _trace_line("engine.harvest", 30.0, width=16, slot_count=3),
+        # an estimator pause happens HERE in wall-clock; the next batch's
+        # dur is unaffected (no differencing)
+        _trace_line("engine.batch", 33.0, width=16, slot_count=3,
+                    coalitions=16, padding=0, epochs=128),
+        _trace_line("engine.batch", 12.0, width=16, slot_count=None,
+                    coalitions=10, padding=6, epochs=80),
+        '{"truncated": ',
+    ]
+    trace.write_text("\n".join(lines) + "\n")
+    times = proj.parse_batch_times(str(trace))
+    assert times == {3: [31.0, 33.0], None: [12.0]}
+    split = proj.parse_trace_split(str(trace))
+    assert split == {"evaluate_s": 100.0, "prep_s": 0.5,
+                     "dispatch_s": 0.2, "harvest_s": 30.0}
+
+
+def test_telemetry_split_reads_prep_row(tmp_path):
+    """The bench sidecar's wall-clock split — including the new
+    engine.prep row — loads for the projection summary; a pre-prep-schema
+    sidecar loads with prep_s = 0 instead of failing."""
+    import json
+    new = tmp_path / "telemetry_config1.json"
+    new.write_text(json.dumps({
+        "metric": "m", "wallclock_s": 300.0,
+        "report": {"wallclock": {"evaluate_s": 290.0, "compile_s": 1.0,
+                                 "prep_s": 2.5, "dispatch_s": 8.0,
+                                 "harvest_s": 250.0}}}))
+    w = proj.load_telemetry_split(str(new))
+    assert w["prep_s"] == 2.5 and w["evaluate_s"] == 290.0
+    old = tmp_path / "telemetry_old.json"
+    old.write_text(json.dumps({
+        "metric": "m",
+        "report": {"wallclock": {"evaluate_s": 290.0, "compile_s": 1.0,
+                                 "dispatch_s": 8.0, "harvest_s": 250.0}}}))
+    assert proj.load_telemetry_split(str(old))["prep_s"] == 0.0
 
 
 @pytest.mark.skipif(not R4_ISLOG.exists(), reason="r4 artifact absent")

@@ -238,14 +238,20 @@ def test_slot_pow2_bucketing_matches_exact(monkeypatch):
     subsets = powerset_order(5)
     monkeypatch.delenv("MPLC_TPU_SLOT_POW2", raising=False)
     monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    # merge is the default bucketing now: the tight per-size reference
+    # needs the explicit opt-out
+    monkeypatch.setenv("MPLC_TPU_SLOT_MERGE", "0")
     ref_eng = CharacteristicEngine(scenario())
+    assert ref_eng.scenario.slot_bucketing == "exact"
     ref_vals = ref_eng.evaluate(subsets)
     assert sorted(ref_eng._slot_pipes) == [2, 3, 4, 5]
 
+    monkeypatch.delenv("MPLC_TPU_SLOT_MERGE", raising=False)
     monkeypatch.setenv("MPLC_TPU_SLOT_POW2", "1")
     eng = CharacteristicEngine(scenario())
+    assert eng.scenario.slot_bucketing == "pow2"
     vals = eng.evaluate(subsets)
-    np.testing.assert_allclose(vals, ref_vals, atol=1e-4)
+    np.testing.assert_array_equal(vals, ref_vals)
     assert sorted(eng._slot_pipes) == [2, 4, 5]  # 3->4; 5 capped at P
 
 
@@ -468,13 +474,14 @@ def test_2d_partner_sharded_hlo_collective_budget(monkeypatch):
 
 
 def test_pipeline_batches_matches_default(monkeypatch):
-    """MPLC_TPU_PIPELINE_BATCHES=1 double-buffers coalition batches:
+    """Batch pipelining (the default) double-buffers coalition batches:
     batch i+1 is dispatched before batch i's results are fetched, so the
     device crosses batch boundaries without idling through host-side
-    bookkeeping. Results must be IDENTICAL to the default engine — the
-    same compiled executables run on the same per-coalition rng streams;
-    only the harvest point moves. cap=1 forces multiple batches per
-    evaluate() call so the pending-harvest path really executes."""
+    bookkeeping. Results must be IDENTICAL to the sequential engine
+    (MPLC_TPU_PIPELINE_BATCHES=0 opt-out) — the same compiled executables
+    run on the same per-coalition rng streams; only the harvest point
+    moves. cap=1 forces multiple batches per evaluate() call so the
+    pending-harvest path really executes."""
     from helpers import build_scenario
     from mplc_tpu.contrib.engine import CharacteristicEngine
     from mplc_tpu.contrib.shapley import powerset_order
@@ -486,13 +493,15 @@ def test_pipeline_batches_matches_default(monkeypatch):
                               gradient_updates_per_pass_count=2, seed=11)
 
     subsets = powerset_order(5)
-    monkeypatch.delenv("MPLC_TPU_PIPELINE_BATCHES", raising=False)
+    monkeypatch.setenv("MPLC_TPU_PIPELINE_BATCHES", "0")
     monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
-    ref_vals = CharacteristicEngine(scenario()).evaluate(subsets)
+    seq_eng = CharacteristicEngine(scenario())
+    assert not seq_eng._pipeline_batches
+    ref_vals = seq_eng.evaluate(subsets)
 
-    monkeypatch.setenv("MPLC_TPU_PIPELINE_BATCHES", "1")
+    monkeypatch.delenv("MPLC_TPU_PIPELINE_BATCHES", raising=False)
     eng = CharacteristicEngine(scenario())
-    assert eng._pipeline_batches
+    assert eng._pipeline_batches  # overlap is the default now
     progressed = []
     eng.progress = lambda done, rem, slots: progressed.append((done, rem, slots))
     vals = eng.evaluate(subsets)
