@@ -42,7 +42,9 @@ MPLC_TPU_NO_SLOTS=1 for masked full-width execution, MPLC_TPU_SLOT_MERGE=0
 / MPLC_TPU_SLOT_POW2=1 for the exact / pow2 slot bucketings (default:
 merged adjacent sizes), MPLC_TPU_PIPELINE_BATCHES=0 to opt out of batch
 overlap, MPLC_TPU_BATCH_CAP_CEILING to lift the batch-cap autotune past
-16, MPLC_TPU_SYNTH_SCALE for smaller data on CPU smoke runs,
+16, MPLC_TPU_STEP_WIDTH_MULT=k for the fused wide-step deviation mode
+(k consecutive sub-batches per SGD step; default 1 = exact parity),
+MPLC_TPU_SYNTH_SCALE for smaller data on CPU smoke runs,
 MPLC_TPU_SYNTH_NOISE (default 0.75 here: accuracy must not saturate, or
 every Shapley value degenerates to 1/N — BENCH_r02's flaw).
 """
@@ -149,19 +151,45 @@ def _fallback_allowed() -> bool:
             and not os.environ.get("BENCH_IS_FALLBACK_CHILD"))
 
 
+# the driver-shaped workload per config: the cached-record metric prefix a
+# replay may match, for the epochs-8 default. Configs 2-5 hardcode their
+# dataset/partner count in main(); only BENCH_METHOD (and the global knob
+# list) can reshape them, and config 1 additionally reads
+# BENCH_PARTNERS/BENCH_DATASET.
+_REPLAY_SHAPES = {
+    "1": "exact_shapley_mnist_10partners_8epochs",
+    "2": "tmcs_cifar10_5partners_8epochs",
+    "3": "is_lin_s_mnist_10partners_8epochs",
+    "4": "smcs_imdb_4partners_8epochs",
+    "5": "tmcs_cifar10_8partners_8epochs",
+}
+
+
 def _replay_cached_tpu_result(repo_root: str | None = None) -> bool:
-    """Tunnel down and this is the driver-shaped run (default config):
-    prefer re-emitting a real TPU measurement of the SAME workload recorded
-    earlier (scripts/r5_queue.sh runs the driver-shaped bench the moment
-    the tunnel answers and saves the line to perf/r*/config1.json) over a
-    reduced CPU-fallback number. The metric is suffixed `_cached` and the
-    provenance (file, mtime) goes to stderr — this is a replayed
-    measurement, never a fresh one. Returns True when a line was emitted."""
-    if (os.environ.get("BENCH_CONFIG", "1") != "1"
-            or os.environ.get("BENCH_PARTNERS", "10") != "10"
+    """Tunnel down and this is a driver-shaped run (default workload for
+    the selected config): prefer re-emitting a real TPU measurement of the
+    SAME workload recorded earlier (scripts/r5_queue.sh runs the
+    driver-shaped bench the moment the tunnel answers and saves the line
+    to perf/r*/config<N>.json) over a reduced CPU-fallback number. The
+    metric is suffixed `_cached` and the provenance (file, mtime) goes to
+    stderr — this is a replayed measurement, never a fresh one. Returns
+    True when a line was emitted."""
+    config = os.environ.get("BENCH_CONFIG", "1")
+    prefix = _REPLAY_SHAPES.get(config)
+    if (prefix is None
             or os.environ.get("BENCH_EPOCHS", "8") != "8"
-            or os.environ.get("BENCH_DATASET", "mnist") != "mnist"
             or os.environ.get("BENCH_METRIC_SUFFIX")):
+        return False
+    if config == "1":
+        # config 1 is the only config whose partner count / dataset are
+        # env-shaped; they must sit at the driver defaults
+        if (os.environ.get("BENCH_PARTNERS", "10") != "10"
+                or os.environ.get("BENCH_DATASET", "mnist") != "mnist"):
+            return False
+    elif os.environ.get("BENCH_METHOD"):
+        # configs 2-5: ANY set method refuses — even re-stating the
+        # default would make the gate's strictness depend on string
+        # comparison against per-config defaults duplicated here
         return False
     # any workload-shaping knob off its default makes the cached full-scale
     # measurement a DIFFERENT workload — same set _spawn_cpu_fallback strips
@@ -174,13 +202,14 @@ def _replay_cached_tpu_result(repo_root: str | None = None) -> bool:
                  "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_NO_SLOTS",
                  "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
                  "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
-                 "MPLC_TPU_SYNTH_SCALE"):
+                 "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SYNTH_SCALE"):
         if os.environ.get(knob):
             return False
     import glob
     repo = repo_root or os.path.dirname(os.path.abspath(__file__))
     best = None
-    for path in glob.glob(os.path.join(repo, "perf", "r*", "config1.json")):
+    for path in glob.glob(os.path.join(repo, "perf", "r*",
+                                       f"config{config}.json")):
         try:
             with open(path) as f:
                 rec = json.loads(f.read().strip())
@@ -188,7 +217,7 @@ def _replay_cached_tpu_result(repo_root: str | None = None) -> bool:
             continue
         metric = rec.get("metric", "")
         if ("_cpu_fallback" in metric or "_cached" in metric
-                or not metric.startswith("exact_shapley_mnist_10partners_8epochs")
+                or not metric.startswith(prefix)
                 or not isinstance(rec.get("value"), (int, float))
                 or "unit" not in rec):
             continue
@@ -244,7 +273,7 @@ def _spawn_cpu_fallback() -> int:
                  "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_NO_SLOTS",
                  "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
                  "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
-                 "MPLC_TPU_SYNTH_SCALE",
+                 "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SYNTH_SCALE",
                  "BENCH_STALL_TIMEOUT", "BENCH_INIT_TIMEOUT",
                  # the child writes its own _cpu_fallback-suffixed sidecar;
                  # inheriting an explicit path would race the parent's file
@@ -439,26 +468,38 @@ def _peak_flops_per_chip():
     return None
 
 
-def _throughput_note(engine, elapsed):
+def _compute_inputs(engine):
+    """(fwd FLOPs/sample, fleet peak FLOPs) — the MFU-proxy inputs, probed
+    ONCE per bench run and shared by the throughput note and the sweep
+    report (the XLA cost-model lowering and the device-kind query are not
+    free, and probing twice doubled their stderr notes). FLOPs prefer
+    XLA's cost model, falling back to the analytic models/zoo estimate;
+    peak is the whole attached fleet's (samples_trained aggregates across
+    devices), None when the chip kind is unknown or host-CPU."""
+    flops = _fwd_flops_per_sample(engine)
+    if flops is None:
+        from mplc_tpu.models.zoo import fwd_flops_per_sample
+        flops = fwd_flops_per_sample(engine.model.name)
+    peak = _peak_flops_per_chip()
+    return flops, (peak * _ndev() if peak else None)
+
+
+def _throughput_note(engine, elapsed, flops=None, fleet_peak=None):
     """Training throughput of the timed sweep: coalition-epochs/s, training
     samples/s, and a conservative model-FLOPs rate (fwd+bwd ~ 3x fwd; val /
     test evals and padded batch slots excluded — the true device rate is
-    higher). The MFU estimate divides by the chip's bf16 peak."""
+    higher). The MFU estimate divides by the fleet's bf16 peak."""
     ep, sa = engine.epochs_trained, engine.samples_trained
     if not ep or elapsed <= 0:
         return
     line = (f"[bench] throughput: {ep} coalition-epochs "
             f"({ep / elapsed:.2f}/s), "
             f"{sa / elapsed / 1e3:.1f}k training samples/s")
-    flops = _fwd_flops_per_sample(engine)
     if flops:
         achieved = 3.0 * flops * sa / elapsed
         line += f", >={achieved / 1e12:.2f} TFLOP/s model compute"
-        peak = _peak_flops_per_chip()
-        if peak:
-            # samples_trained aggregates across all devices — normalize by
-            # the whole attached fleet's peak, not one chip's
-            line += f" (>={100 * achieved / (peak * _ndev()):.1f}% MFU)"
+        if fleet_peak:
+            line += f" (>={100 * achieved / fleet_peak:.1f}% MFU)"
     print(line, file=sys.stderr, flush=True)
 
 
@@ -557,10 +598,11 @@ def bench_exact_shapley(epochs, dtype):
           f"{elapsed / B:.3f} s/coalition on {_ndev()} device(s); projected "
           f"v5e-8 (8-way coal sharding, zero-communication axis => ~linear): "
           f"{elapsed / 8:.1f} s", file=sys.stderr)
-    _throughput_note(timed, elapsed)
+    flops, fleet_peak = _compute_inputs(timed)
+    _throughput_note(timed, elapsed, flops, fleet_peak)
     metric = f"exact_shapley_{dataset}_{n_partners}partners_{epochs}epochs_wallclock"
     from mplc_tpu.obs.report import format_report, sweep_report
-    rep = sweep_report(tele)
+    rep = sweep_report(tele, flops_per_sample=flops, peak_flops=fleet_peak)
     print(format_report(rep), file=sys.stderr, flush=True)
     _write_telemetry({"metric": metric, "wallclock_s": elapsed,
                       "devices": _ndev(), "report": rep})
@@ -613,11 +655,12 @@ def _bench_method(dataset_name, n_partners, method, epochs, dtype,
     print(f"[bench] engine.evaluate {engine_time['s']:.1f} s, host-side "
           f"estimator {host:.1f} s ({100 * host / max(elapsed, 1e-9):.1f}% "
           f"of wall-clock)", file=sys.stderr)
-    _throughput_note(timed, elapsed)
+    flops, fleet_peak = _compute_inputs(timed)
+    _throughput_note(timed, elapsed, flops, fleet_peak)
     tag = method.lower().replace(" ", "_")
     metric = f"{tag}_{dataset_name}_{n_partners}partners_{epochs}epochs_wallclock"
     from mplc_tpu.obs.report import format_report, sweep_report
-    rep = sweep_report(tele)
+    rep = sweep_report(tele, flops_per_sample=flops, peak_flops=fleet_peak)
     print(format_report(rep), file=sys.stderr, flush=True)
     _write_telemetry({"metric": metric, "wallclock_s": elapsed,
                       "devices": _ndev(), "report": rep})

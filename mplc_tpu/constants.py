@@ -88,6 +88,17 @@ def _env_positive_int(name: str, default: int) -> int:
 
 EVAL_CHUNK_SIZE = _env_positive_int("MPLC_TPU_EVAL_CHUNK", 2048)
 
+# Fused wide-step mode (MPLC_TPU_STEP_WIDTH_MULT=k): fold k consecutive
+# gradient_updates_per_pass sub-batches into ONE k-x-wider SGD step inside
+# every multi-partner pass. k=1 (the default) is bit-identical to the
+# historical per-sub-batch stepping; k>1 is an OPT-IN DOCUMENTED DEVIATION
+# from the reference trajectory (fewer, wider optimizer updates per
+# minibatch — ceil(gup/k) instead of gup) that raises per-step arithmetic
+# intensity on MXU-hostile small sub-batches. Read once at import time,
+# same contract as MPLC_TPU_EVAL_CHUNK: the step grid is baked into the
+# compiled programs, and a malformed value warns + falls back to 1.
+STEP_WIDTH_MULT = _env_positive_int("MPLC_TPU_STEP_WIDTH_MULT", 1)
+
 # Ceiling for the HBM-derived coalitions-per-device autotune
 # (contrib/engine.py _device_batch_cap). 16 is the measured sweet spot for
 # per-size slot programs (cap-32 bisect, perf/r4/tune_cap32.log); with

@@ -270,11 +270,14 @@ class CharacteristicEngine:
             MplTrainer.get(self.model, multi_cfg), self.partners_count)
         self.single_pipe = BatchedTrainerPipeline(
             MplTrainer.get(self.model, single_cfg), self.partners_count)
-        # Slot execution (fedavg): a size-k coalition trains k partner slots
-        # instead of P masked ones — ~2x less compute on a full Shapley
-        # sweep. One pipeline per coalition size, built lazily.
-        self._use_slots = (multi_cfg.approach == "fedavg"
-                           and os.environ.get("MPLC_TPU_NO_SLOTS") != "1")
+        # Slot execution (fedavg + the seq family): a size-k coalition
+        # trains k partner slots instead of P masked ones — ~2x less compute
+        # on a full Shapley sweep. For the seq approaches the win is the
+        # P-|S| wasted no-op partner visits per minibatch the masked scan
+        # pays. One pipeline per coalition size, built lazily.
+        self._use_slots = (multi_cfg.approach in (
+            "fedavg", "seq-pure", "seq-with-final-agg", "seqavg")
+            and os.environ.get("MPLC_TPU_NO_SLOTS") != "1")
         self._slot_pow2 = os.environ.get("MPLC_TPU_SLOT_POW2") == "1"
         # Slot-bucket merging (the default between `exact` and `pow2`):
         # adjacent coalition sizes share one slot program — size k rides
@@ -541,6 +544,12 @@ class CharacteristicEngine:
         per_partner = (self._epoch_samples_single
                        if pipe is self.single_pipe
                        else self._epoch_samples_multi)
+        # partner passes executed per coalition-minibatch on this pipe: the
+        # intensity accounting behind engine.partner_passes (slot execution
+        # trains <= slot_count passes where the masked path trains P)
+        passes_per_mb = (1 if pipe is self.single_pipe
+                         else slot_count if slot_count is not None
+                         else self.partners_count)
 
         # Whole-call host prep, once per bucket instead of once per batch:
         # one NumPy scatter builds every coalition row and every rng fold
@@ -563,7 +572,9 @@ class CharacteristicEngine:
                 i += len(group)
                 attrs = {"width": b, "slot_count": slot_count,
                          "coalitions": len(group), "padding": b - len(group)}
-                meta = {**attrs, "t0": time.perf_counter()}
+                meta = {**attrs, "t0": time.perf_counter(),
+                        "passes_per_mb": passes_per_mb,
+                        "mb_count": pipe.trainer.cfg.minibatch_count}
                 with obs_trace.span("engine.dispatch", **attrs):
                     rngs = self._batch_rngs(words, n_words, sel)
                     coal = jnp.asarray(coal_all[sel])
@@ -611,12 +622,20 @@ class CharacteristicEngine:
                             coalitions=meta["coalitions"]):
             accs, epochs = fetch()
         batch_epochs = 0
+        batch_samples = 0
         for s, acc, ep in zip(group, accs[:len(group)], epochs[:len(group)]):
             self._store(s, float(acc))
             batch_epochs += int(ep)
-            self.samples_trained += int(ep) * int(
+            batch_samples += int(ep) * int(
                 sum(int(per_partner[i]) for i in s))
         self.epochs_trained += batch_epochs
+        self.samples_trained += batch_samples
+        # partner passes executed on device for this batch, INCLUDING the
+        # padded/inactive slot or mask rows (what the hardware ran, not just
+        # the useful share): epochs x minibatches x passes-per-minibatch,
+        # both captured at dispatch from the pipe that actually ran.
+        batch_passes = (batch_epochs * meta.get("mb_count", 1)
+                        * meta.get("passes_per_mb", 1))
         # per-batch telemetry: dur spans dispatch-start -> harvest-end (under
         # batch pipelining consecutive batches overlap, so these durations
         # sum to more than wall-clock — a utilization view). All host-side;
@@ -625,8 +644,11 @@ class CharacteristicEngine:
             "engine.batch", dur=time.perf_counter() - meta["t0"],
             width=meta["width"], slot_count=slot_count,
             coalitions=meta["coalitions"], padding=meta["padding"],
-            epochs=batch_epochs)
+            epochs=batch_epochs, samples=batch_samples,
+            partner_passes=batch_passes)
         obs_metrics.counter("engine.epochs_trained").inc(batch_epochs)
+        obs_metrics.counter("engine.samples_trained").inc(batch_samples)
+        obs_metrics.counter("engine.partner_passes").inc(batch_passes)
         obs_metrics.histogram("engine.pad_waste_fraction").observe(
             meta["padding"] / meta["width"])
         obs_metrics.sample_device_memory()
@@ -679,7 +701,9 @@ class CharacteristicEngine:
                 i += len(group)
                 attrs = {"width": b, "slot_count": None,
                          "coalitions": len(group), "padding": b - len(group)}
-                meta = {**attrs, "t0": time.perf_counter()}
+                meta = {**attrs, "t0": time.perf_counter(),
+                        "passes_per_mb": 1,
+                        "mb_count": pipe.trainer.cfg.minibatch_count}
                 with obs_trace.span("engine.dispatch", **attrs):
                     ids = ids_all[sel]
                     sliced = StackedPartners(
@@ -853,6 +877,9 @@ class CharacteristicEngine:
             "epoch_count": cfg.epoch_count,
             "minibatch_count": cfg.minibatch_count,
             "gradient_updates_per_pass": cfg.gradient_updates_per_pass,
+            # the wide-step deviation changes every trajectory at mult > 1:
+            # a cache built under one mult describes a different game
+            "step_width_mult": cfg.step_width_mult,
             "compute_dtype": cfg.compute_dtype,
             "split": [str(getattr(sc, "samples_split_type", "?")),
                       str(getattr(sc, "samples_split_description", "?"))],
@@ -890,6 +917,9 @@ class CharacteristicEngine:
         with open(path) as f:
             payload = json.load(f)
         theirs = payload.get("fingerprint", {})
+        # caches saved before the wide-step knob existed ran at the only
+        # stepping there was — today's mult=1
+        theirs.setdefault("step_width_mult", 1)
         ours = self._fingerprint()
         if "partners_count" in theirs and \
                 theirs["partners_count"] != ours["partners_count"]:

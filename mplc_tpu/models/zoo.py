@@ -193,3 +193,59 @@ MODELS = {
     "esc50_cnn": ESC50_CNN,
     "titanic_logreg": TITANIC_LOGREG,
 }
+
+
+# ---------------------------------------------------------------------------
+# Analytic forward-pass FLOPs per sample (the MFU-proxy numerator)
+# ---------------------------------------------------------------------------
+
+def _conv2d_flops(h_out: int, w_out: int, kh: int, kw: int,
+                  cin: int, cout: int) -> int:
+    """2 FLOPs (multiply + add) per MAC of a 2-D convolution."""
+    return 2 * h_out * w_out * kh * kw * cin * cout
+
+
+def _dense_flops(n_in: int, n_out: int) -> int:
+    return 2 * n_in * n_out
+
+
+def fwd_flops_per_sample(model_name: str) -> int | None:
+    """Analytic forward-pass FLOPs for one sample of the named built-in
+    family (matmul/conv MACs x 2; elementwise ops and the embedding gather
+    are negligible and excluded). The observability layer multiplies by 3
+    for fwd+bwd when turning trained-sample counts into a model-FLOPs rate
+    — the conventional conservative training estimate, same convention as
+    bench.py's XLA-cost-model line. Returns None for unknown families
+    (e.g. test-only custom models), in which case the MFU-proxy row is
+    simply omitted."""
+    if model_name == "mnist_cnn":
+        # 28x28x1: conv3x3->26x26x32, conv3x3->24x24x64, pool -> 12x12x64
+        return (_conv2d_flops(26, 26, 3, 3, 1, 32)
+                + _conv2d_flops(24, 24, 3, 3, 32, 64)
+                + _dense_flops(12 * 12 * 64, 128)
+                + _dense_flops(128, 10))
+    if model_name == "cifar10_cnn":
+        # 32x32x3: conv same 32x32x32, conv 30x30x32, pool 15x15;
+        # conv same 15x15x64, conv 13x13x64, pool 6x6
+        return (_conv2d_flops(32, 32, 3, 3, 3, 32)
+                + _conv2d_flops(30, 30, 3, 3, 32, 32)
+                + _conv2d_flops(15, 15, 3, 3, 32, 64)
+                + _conv2d_flops(13, 13, 3, 3, 64, 64)
+                + _dense_flops(6 * 6 * 64, 512)
+                + _dense_flops(512, 10))
+    if model_name == "imdb_conv1d":
+        # embed gather (no MACs) -> conv1d k3 same over [500, 32] -> pool 250
+        return (2 * IMDB_SEQ_LEN * 3 * 32 * 32
+                + _dense_flops((IMDB_SEQ_LEN // 2) * 32, 256)
+                + _dense_flops(256, 64)
+                + _dense_flops(64, 1))
+    if model_name == "esc50_cnn":
+        # 40x431x1: conv k2 valid + pool2, four stages
+        return (_conv2d_flops(39, 430, 2, 2, 1, 16)
+                + _conv2d_flops(18, 214, 2, 2, 16, 32)
+                + _conv2d_flops(8, 106, 2, 2, 32, 64)
+                + _conv2d_flops(3, 52, 2, 2, 64, 128)
+                + _dense_flops(128, 50))
+    if model_name == "titanic_logreg":
+        return _dense_flops(27, 1)
+    return None

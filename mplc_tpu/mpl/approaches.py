@@ -228,6 +228,13 @@ class MplLabelFlip(MultiPartnerLearning):
 
 
 class SinglePartnerLearning(MultiPartnerLearning):
+    """Class-path analogue of the engine's sliced-singles rule
+    (contrib/engine.py `_run_singles_sliced`): `partners_list` is pinned to
+    `[partner]` BEFORE staging, so `_stage` builds a [1, n_own, ...] tensor
+    — this partner's rows only, never the scenario's full stacked axis
+    padded to the largest partner (locked by
+    tests/test_mpl.py::test_single_partner_class_stages_only_its_partner)."""
+
     approach_key = "single"
 
     def __init__(self, scenario, partner=None, **kwargs):

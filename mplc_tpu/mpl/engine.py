@@ -133,9 +133,16 @@ class TrainConfig:
     # compute per coalition — run exactly `slot_count` slots, each bound at
     # runtime to a partner index. The coalition argument becomes an int32
     # id array [slot_count] (pad with -1) instead of a float mask [P].
-    # fedavg only; RNG streams are keyed by partner id, so slotted and
-    # masked runs train identically.
+    # fedavg and the seq family; RNG streams are keyed by partner id (and,
+    # for seq visit order, by the full-width order-key draw), so slotted
+    # and masked runs train identically.
     slot_count: int | None = None
+    # Fused wide-step mode: fold `step_width_mult` consecutive
+    # gradient_updates_per_pass sub-batches into one wider SGD step inside
+    # _partner_pass. 1 (default, from MPLC_TPU_STEP_WIDTH_MULT) = exact
+    # parity with the historical per-sub-batch stepping; >1 is a documented
+    # deviation (ceil(gup/mult) optimizer updates per pass instead of gup).
+    step_width_mult: int = constants.STEP_WIDTH_MULT
 
     def __post_init__(self):
         if self.approach not in APPROACH_NAMES:
@@ -146,9 +153,14 @@ class TrainConfig:
             raise ValueError(
                 f"partner-axis sharding requires a partner-parallel approach "
                 f"(fedavg/lflip), got '{self.approach}'")
+        if self.step_width_mult < 1:
+            raise ValueError(
+                f"step_width_mult must be >= 1, got {self.step_width_mult}")
         if self.slot_count is not None:
-            if self.approach != "fedavg":
-                raise ValueError("slot execution supports fedavg only")
+            if self.approach not in ("fedavg", "seq-pure",
+                                     "seq-with-final-agg", "seqavg"):
+                raise ValueError("slot execution supports fedavg and the "
+                                 "seq family only")
             if self.partner_axis is not None:
                 raise ValueError("slot execution and partner-axis sharding "
                                  "are mutually exclusive")
@@ -365,13 +377,19 @@ class MplTrainer:
         return jax.vmap(one)(jnp.arange(P, dtype=jnp.int32) + offset, mask_pn)
 
     def _subbatch(self, perm_p, size_p, mb_i, g, sb_cap):
-        """Indices + mask for gradient step g of minibatch mb_i of one partner."""
-        mbc, gup = self.cfg.minibatch_count, self.cfg.gradient_updates_per_pass
+        """Indices + mask for (fused) gradient step g of minibatch mb_i of
+        one partner. With `step_width_mult` = k > 1, step g covers the k
+        consecutive base sub-batch windows g*k .. g*k+k-1 as one contiguous
+        k-x-wider window (the fused wide-step mode); k = 1 reproduces the
+        base per-sub-batch window bit-for-bit (same shapes, same values)."""
+        cfg = self.cfg
+        mbc, gup = cfg.minibatch_count, cfg.gradient_updates_per_pass
+        mult = cfg.step_width_mult
         valid_mb = size_p // mbc                      # samples per minibatch
-        sb = (valid_mb + gup - 1) // gup              # samples per step
-        ar = jnp.arange(sb_cap, dtype=jnp.int32)
-        local = g * sb + ar
-        valid = (ar < sb) & (local < valid_mb)
+        sb = (valid_mb + gup - 1) // gup              # samples per base step
+        ar = jnp.arange(sb_cap * mult, dtype=jnp.int32)
+        local = g * (sb * mult) + ar
+        valid = (ar < sb * mult) & (local < valid_mb)
         pos = mb_i * valid_mb + local
         idx = perm_p[jnp.clip(pos, 0, perm_p.shape[0] - 1)]
         return idx, valid.astype(jnp.float32)
@@ -410,7 +428,9 @@ class MplTrainer:
     def _partner_pass(self, start_params, x_p, y_p, perm_p, size_p, active_p,
                       mb_i, rng_p, opt_state=None, y_override=None,
                       window_idx=None, row_offset=0, n_max=None):
-        """Run `gup` masked SGD steps for one partner on minibatch mb_i.
+        """Run the pass's masked SGD steps for one partner on minibatch mb_i:
+        `gup` base steps, fused into ceil(gup / step_width_mult) wider steps
+        when the wide-step mode is on (mult = 1 is bit-identical).
 
         If `y_override`/`window_idx` are given (lflip), steps slice rows from
         that pre-gathered minibatch window instead of the raw arrays.
@@ -420,10 +440,12 @@ class MplTrainer:
         Returns (params, opt_state, pass_loss, pass_acc).
         """
         cfg = self.cfg
+        mult = cfg.step_width_mult
         if n_max is None:
             n_max = x_p.shape[0]
         mb_cap = max(n_max // cfg.minibatch_count, 1)
         sb_cap = (mb_cap + cfg.gradient_updates_per_pass - 1) // cfg.gradient_updates_per_pass
+        n_steps = (cfg.gradient_updates_per_pass + mult - 1) // mult
         fresh = opt_state is None
         if fresh:
             opt_state = self.opt.init(start_params)
@@ -436,8 +458,9 @@ class MplTrainer:
                 mbc, gup = cfg.minibatch_count, cfg.gradient_updates_per_pass
                 valid_mb = size_p // mbc
                 sb = (valid_mb + gup - 1) // gup
-                ar = jnp.arange(sb_cap, dtype=jnp.int32)
-                local = jnp.clip(g * sb + ar, 0, y_override.shape[0] - 1)
+                ar = jnp.arange(sb_cap * mult, dtype=jnp.int32)
+                local = jnp.clip(g * (sb * mult) + ar,
+                                 0, y_override.shape[0] - 1)
                 x = jnp.take(x_p, jnp.take(window_idx, local, axis=0), axis=0)
                 y = jnp.take(y_override, local, axis=0)
             else:
@@ -452,7 +475,7 @@ class MplTrainer:
 
         (params, opt_state, sums), _ = lax.scan(
             step, (start_params, opt_state, (0.0, 0.0, 0.0)),
-            jnp.arange(cfg.gradient_updates_per_pass))
+            jnp.arange(n_steps))
         denom = jnp.maximum(sums[2], 1.0)
         return params, opt_state, sums[0] / denom, sums[1] / denom
 
@@ -567,6 +590,33 @@ class MplTrainer:
         return state._replace(params=params, theta=theta, val_loss_h=vl_h,
                               val_acc_h=va_h, partner_h=p_h)
 
+    def _slot_binding(self, stacked, active_ids, rng):
+        """Shared slot-execution prep: bind each slot to its partner's data
+        (row offsets into the flat [P*Nmax, ...] view — one fused gather, no
+        per-slot copy) and draw each slot's epoch permutation keyed by
+        GLOBAL partner id, the identical stream to the masked path's
+        `_epoch_perms`. Returns (ids, active, pids, flat_x, flat_y,
+        slot_sizes, perms)."""
+        P, n_max = stacked.x.shape[0], stacked.x.shape[1]
+        ids = active_ids.astype(jnp.int32)            # [K]
+        active = (ids >= 0).astype(jnp.float32)       # [K]
+        pids = jnp.maximum(ids, 0)                    # [K] safe partner rows
+
+        flat_x = stacked.x.reshape((P * n_max,) + stacked.x.shape[2:])
+        flat_y = stacked.y.reshape((P * n_max,) + stacked.y.shape[2:])
+        slot_sizes = jnp.take(stacked.sizes, pids, axis=0)          # [K]
+        slot_mask_rows = jnp.take(stacked.mask, pids, axis=0)       # [K, Nmax]
+
+        rng_perm = jax.random.fold_in(rng, 0)
+
+        def perm_of(pid, mask_row):
+            keys = jax.random.uniform(jax.random.fold_in(rng_perm, pid),
+                                      mask_row.shape) + (1.0 - mask_row) * 1e9
+            return jnp.argsort(keys).astype(jnp.int32)
+
+        perms = jax.vmap(perm_of)(pids, slot_mask_rows)             # [K, Nmax]
+        return ids, active, pids, flat_x, flat_y, slot_sizes, perms
+
     def _fedavg_slot_epoch(self, state: TrainState, stacked, val: EvalSet,
                            active_ids, rng) -> TrainState:
         """fedavg epoch over `slot_count` partner slots instead of all P
@@ -577,26 +627,8 @@ class MplTrainer:
         cfg = self.cfg
         e = state.epoch
         P, n_max = stacked.x.shape[0], stacked.x.shape[1]
-
-        ids = active_ids.astype(jnp.int32)            # [K]
-        active = (ids >= 0).astype(jnp.float32)       # [K]
-        pids = jnp.maximum(ids, 0)                    # [K] safe partner rows
-
-        flat_x = stacked.x.reshape((P * n_max,) + stacked.x.shape[2:])
-        flat_y = stacked.y.reshape((P * n_max,) + stacked.y.shape[2:])
-        slot_sizes = jnp.take(stacked.sizes, pids, axis=0)          # [K]
-        slot_mask_rows = jnp.take(stacked.mask, pids, axis=0)       # [K, Nmax]
-
-        # per-slot epoch permutation, keyed by GLOBAL partner id (identical
-        # stream to the masked path's _epoch_perms)
-        rng_perm = jax.random.fold_in(rng, 0)
-
-        def perm_of(pid, mask_row):
-            keys = jax.random.uniform(jax.random.fold_in(rng_perm, pid),
-                                      mask_row.shape) + (1.0 - mask_row) * 1e9
-            return jnp.argsort(keys).astype(jnp.int32)
-
-        perms = jax.vmap(perm_of)(pids, slot_mask_rows)             # [K, Nmax]
+        ids, active, pids, flat_x, flat_y, slot_sizes, perms = \
+            self._slot_binding(stacked, active_ids, rng)
 
         def mb_body(carry, mb_i):
             params, vl_h, va_h, p_h = carry
@@ -712,6 +744,108 @@ class MplTrainer:
         return state._replace(params=params, val_loss_h=vl_h, val_acc_h=va_h,
                               partner_h=p_h)
 
+    def _seq_slot_epoch(self, state: TrainState, stacked, val: EvalSet,
+                        active_ids, rng) -> TrainState:
+        """seq-family epoch over `slot_count` partner slots: the partner
+        scan visits K bound slots instead of all P partners, so a size-k
+        coalition costs k sequential passes, not P (the inactive visits the
+        masked path spends on no-op passes vanish).
+
+        Bit-equality with `_seq_epoch`: the visit order is an active-first
+        permutation, so active partners occupy scan positions 0..|S|-1 in
+        both paths — and the pass rng is keyed by POSITION (`pos + 1`), so
+        the order keys must come from the masked path's full-width [P]
+        uniform draw (gathered per slot, not redrawn at width K) for the
+        relative order of the active partners to be identical. Epoch
+        permutations are keyed by global partner id (`_slot_binding`), and
+        padded `-1` slots sort last with zero aggregation weight, exactly
+        like the masked path's inactive tail."""
+        cfg = self.cfg
+        e = state.epoch
+        P, n_max = stacked.x.shape[0], stacked.x.shape[1]
+        ids, active, pids, flat_x, flat_y, slot_sizes, perms = \
+            self._slot_binding(stacked, active_ids, rng)
+        K = ids.shape[0]
+        partner_stack = broadcast(state.params, K)   # slot-indexed
+
+        def mb_body(carry, mb_i):
+            params, partner_stack, vl_h, va_h, p_h, _ = carry
+            vl, va = self._maybe_val_eval(params, val, mb_i,
+                                          es_col=cfg.minibatch_count - 1)
+            vl_h = vl_h.at[e, mb_i].set(vl)
+            va_h = va_h.at[e, mb_i].set(va)
+
+            rng_mb = jax.random.fold_in(jax.random.fold_in(rng, 1), mb_i)
+            # the masked path's [P] order-key draw, gathered per slot: the
+            # active slots' relative order (and therefore their scan
+            # positions, which key the pass rngs) matches exactly
+            order_keys = jax.random.uniform(jax.random.fold_in(rng_mb, 0),
+                                            (P,))
+            slot_keys = jnp.take(order_keys, pids) + (1.0 - active) * 1e3
+            slot_order = jnp.argsort(slot_keys).astype(jnp.int32)    # [K]
+            opt_state0 = self.opt.init(params)
+            pva_slots0 = jnp.full((K,), jnp.nan, jnp.float32)
+
+            def partner_body(carry2, pos):
+                params, opt_state, partner_stack, p_h, pva_slots = carry2
+                s = slot_order[pos]
+                pid = jnp.take(pids, s)
+                act = jnp.take(active, s)
+                perm_p = jnp.take(perms, s, axis=0)
+                size_p = jnp.take(slot_sizes, s, axis=0)
+                r = jax.random.fold_in(rng_mb, pos + 1)
+                new_params, new_opt, ls, ac = self._partner_pass(
+                    params, flat_x, flat_y, perm_p, size_p, act, mb_i, r,
+                    opt_state=opt_state, row_offset=pid * n_max, n_max=n_max)
+                params = tree_where(act > 0, new_params, params)
+                opt_state = tree_where(act > 0, new_opt, opt_state)
+                partner_stack = jax.tree_util.tree_map(
+                    lambda leaf, newp: leaf.at[s].set(
+                        jnp.where(act > 0, newp, leaf[s])),
+                    partner_stack, params)
+                if cfg.record_partner_val or cfg.aggregator == "local-score":
+                    pvl, pva = self.evaluate(params, val)
+                else:
+                    pvl, pva = jnp.nan, jnp.nan
+                # scatter into the [P]-indexed history; unused slots drop
+                # via an out-of-bounds row (same convention as the fedavg
+                # slot epoch)
+                row = jnp.where(act > 0, pid, P)
+                p_h = p_h.at[:, row, e, mb_i].set(
+                    jnp.stack([ls, ac,
+                               jnp.asarray(pvl, jnp.float32),
+                               jnp.asarray(pva, jnp.float32)]), mode="drop")
+                pva_slots = pva_slots.at[s].set(
+                    jnp.where(act > 0, jnp.asarray(pva, jnp.float32),
+                              jnp.nan))
+                return (params, opt_state, partner_stack, p_h, pva_slots), None
+
+            (params, _, partner_stack, p_h, pva_slots), _ = lax.scan(
+                partner_body,
+                (params, opt_state0, partner_stack, p_h, pva_slots0),
+                jnp.arange(K))
+
+            if cfg.approach == "seqavg":
+                w = aggregation_weights(cfg.aggregator, active, slot_sizes,
+                                        jnp.nan_to_num(pva_slots))
+                params = aggregate(partner_stack, w)
+            return (params, partner_stack, vl_h, va_h, p_h, pva_slots), None
+
+        pva_init = jnp.full((K,), jnp.nan, jnp.float32)
+        (params, partner_stack, vl_h, va_h, p_h, pva_last), _ = lax.scan(
+            mb_body, (state.params, partner_stack, state.val_loss_h,
+                      state.val_acc_h, state.partner_h, pva_init),
+            jnp.arange(cfg.minibatch_count))
+
+        if cfg.approach == "seq-with-final-agg":
+            # pva_last is the final minibatch's per-slot val accuracy — the
+            # slot view of the masked path's p_h[3, :, e, MB-1] column
+            w = aggregation_weights(cfg.aggregator, active, slot_sizes,
+                                    jnp.nan_to_num(pva_last))
+            params = aggregate(partner_stack, w)
+        return state._replace(params=params, val_loss_h=vl_h, val_acc_h=va_h,
+                              partner_h=p_h)
+
     def _single_epoch(self, state: TrainState, stacked, val: EvalSet,
                       coal_mask, rng) -> TrainState:
         """One epoch of single-partner training: `mb*gup` persistent-optimizer
@@ -784,7 +918,12 @@ class MplTrainer:
         cfg = self.cfg
         rng = jax.random.fold_in(rng, state.epoch)
         if cfg.slot_count is not None:
-            new = self._fedavg_slot_epoch(state, stacked, val, coal_mask, rng)
+            if cfg.approach == "fedavg":
+                new = self._fedavg_slot_epoch(state, stacked, val, coal_mask,
+                                              rng)
+            else:
+                new = self._seq_slot_epoch(state, stacked, val, coal_mask,
+                                           rng)
         elif cfg.approach in ("fedavg", "lflip"):
             new = self._fedavg_epoch(state, stacked, val, coal_mask, rng)
         elif cfg.approach == "single":

@@ -15,6 +15,13 @@ Metric names used by the instrumented paths:
     engine.memo_misses                counter  v(S) requiring training
     engine.coalitions_evaluated       counter  coalitions actually trained
     engine.epochs_trained             counter  coalition-epochs executed
+    engine.samples_trained            counter  training samples consumed
+                                               (non-padding coalitions)
+    engine.partner_passes             counter  partner passes dispatched
+                                               (epochs x minibatches x
+                                               slots-or-P: slot execution
+                                               runs <= slot_count where the
+                                               masked path runs P)
     engine.pad_waste_fraction         histogram per-batch padding fraction
     engine.device_mem_high_water_bytes gauge   peak bytes (memory_stats)
 

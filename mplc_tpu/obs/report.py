@@ -16,7 +16,12 @@ quantities every perf PR needs as a measured before/after:
   - per-(slot_count, width) bucket throughput: coalitions and epochs per
     span-second (span-sum, which under MPLC_TPU_PIPELINE_BATCHES counts
     overlapped batches twice — a utilization view, not a wall-clock one);
-  - per-executable compile counts/seconds and per-estimator durations.
+  - per-executable compile counts/seconds and per-estimator durations;
+  - a compute/intensity row: training samples and partner passes summed
+    from the engine.batch events, and — when the caller supplies the
+    model's forward FLOPs per sample (models/zoo.fwd_flops_per_sample or
+    the XLA cost model) — a model-FLOPs rate over the evaluate wall-clock
+    plus an MFU proxy against a supplied peak-FLOPs figure.
 
 The report is derived from SPANS of the collected region only, so callers
 get a clean per-run view without resetting the process-global metrics
@@ -33,13 +38,23 @@ def _attrs(rec: dict) -> dict:
     return rec.get("attrs") or {}
 
 
-def sweep_report(records: list, metrics_snapshot: dict | None = None) -> dict:
-    """Aggregate a list of trace records (dicts) into the sweep report."""
+def sweep_report(records: list, metrics_snapshot: dict | None = None,
+                 flops_per_sample: float | None = None,
+                 peak_flops: float | None = None) -> dict:
+    """Aggregate a list of trace records (dicts) into the sweep report.
+
+    `flops_per_sample` (the model's analytic/XLA-measured forward FLOPs for
+    ONE training sample) turns the summed trained-sample count into a
+    model-FLOPs rate (fwd+bwd ~ 3x fwd, padded rows and val/test evals
+    excluded — a conservative lower bound on the device rate);
+    `peak_flops` (the attached fleet's aggregate peak) additionally yields
+    `mfu_proxy` = achieved / peak."""
     evaluate_s = prep_s = dispatch_s = harvest_s = compile_s = 0.0
     requested = missing = 0
     compiles: dict = {}
     buckets: dict = {}
     batches = coalitions = padding = epochs = 0
+    samples = partner_passes = 0
     estimators = []
     fits = []
 
@@ -77,6 +92,8 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None) -> dict:
             coalitions += int(a.get("coalitions", 0))
             padding += int(a.get("padding", 0))
             epochs += int(a.get("epochs", 0))
+            samples += int(a.get("samples", 0))
+            partner_passes += int(a.get("partner_passes", 0))
         elif name == "contributivity":
             estimators.append({"method": a.get("method", "?"), "seconds": dur})
         elif name == "mpl.fit":
@@ -95,6 +112,30 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None) -> dict:
             "epochs_per_s": b["epochs"] / s if s else None,
         })
 
+    # compute/intensity: model-FLOPs rate over the engine's evaluate
+    # wall-clock (falling back to the bucket span-sum for record sets
+    # collected without an evaluate span). Training compute only — padded
+    # rows and val/test evals are excluded, so the true device rate is
+    # strictly higher; the point is a comparable, attributable proxy.
+    basis_s = evaluate_s or sum(b["seconds"] for b in buckets.values())
+    compute = {
+        "train_samples": samples,
+        "partner_passes": partner_passes,
+        "samples_per_s": samples / basis_s if basis_s else None,
+        "flops_per_sample_fwd": flops_per_sample,
+        "model_flops": None,
+        "model_flops_per_s": None,
+        "peak_flops": peak_flops,
+        "mfu_proxy": None,
+    }
+    if flops_per_sample and samples:
+        compute["model_flops"] = 3.0 * flops_per_sample * samples
+        if basis_s:
+            compute["model_flops_per_s"] = compute["model_flops"] / basis_s
+            if peak_flops:
+                compute["mfu_proxy"] = \
+                    compute["model_flops_per_s"] / peak_flops
+
     report = {
         "wallclock": {
             "evaluate_s": evaluate_s,
@@ -103,6 +144,7 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None) -> dict:
             "dispatch_s": dispatch_s,
             "harvest_s": harvest_s,
         },
+        "compute": compute,
         "memo": {
             "requested": requested,
             "hits": hits,
@@ -149,6 +191,22 @@ def format_report(report: dict) -> str:
         f"padding={b['padding']}  pad_waste="
         + (f"{pw:.1%}" if pw is not None else "n/a")
         + f"  epochs={b['epochs_trained']}")
+    c = report.get("compute") or {}
+    if c.get("train_samples"):
+        sps = c.get("samples_per_s")
+        line = (f"  compute     samples={c['train_samples']}  "
+                f"partner_passes={c['partner_passes']}  samples/s="
+                + (f"{sps:.0f}" if sps is not None else "n/a"))
+        fps = c.get("model_flops_per_s")
+        if fps is not None:
+            line += ("  model_flops/s=" +
+                     (f"{fps / 1e12:.2f}T" if fps >= 1e12 else
+                      f"{fps / 1e9:.2f}G" if fps >= 1e9 else
+                      f"{fps / 1e6:.2f}M"))
+            mfu = c.get("mfu_proxy")
+            line += ("  mfu_proxy=" + (f"{mfu:.2%}" if mfu is not None
+                                       else "n/a"))
+        lines.append(line)
     if report["per_width"]:
         lines.append("  throughput per bucket (slots, width): "
                      "batches  coal  epochs  span-s  coal/s")

@@ -144,6 +144,17 @@ def load_telemetry_split(path):
     return w
 
 
+def load_telemetry_compute(path):
+    """The compute/MFU-proxy row from a bench telemetry sidecar — the
+    measured intensity the projection's width-scaling assumptions rest on.
+    Pre-compute-row sidecars (older report schema) return {} rather than
+    failing."""
+    import json
+    with open(path) as f:
+        rec = json.load(f)
+    return dict(rec.get("report", {}).get("compute", {}) or {})
+
+
 def parse_batch_times(log_path):
     """Per-slot-size batch durations (s), from either input kind:
 
@@ -325,7 +336,25 @@ def main():
               f"prep={w['prep_s']:.1f}s "
               f"dispatch={w.get('dispatch_s', 0.0):.1f}s "
               f"harvest={w.get('harvest_s', 0.0):.1f}s "
-              f"(other host gap ~{gap:.1f}s)\n")
+              f"(other host gap ~{gap:.1f}s)")
+        c = load_telemetry_compute(args.telemetry)
+        if c.get("train_samples"):
+            fps = c.get("model_flops_per_s")
+            mfu = c.get("mfu_proxy")
+            # same T/G/M scale ladder as obs.report.format_report, so a
+            # CPU-mesh sidecar prints MFLOP/s instead of 0.000T
+            fps_txt = ("" if not fps else
+                       " model_flops/s=" +
+                       (f"{fps / 1e12:.2f}T" if fps >= 1e12 else
+                        f"{fps / 1e9:.2f}G" if fps >= 1e9 else
+                        f"{fps / 1e6:.2f}M"))
+            print(f"measured compute: samples={c['train_samples']} "
+                  f"partner_passes={c.get('partner_passes', 0)}" + fps_txt
+                  + (f" mfu_proxy={100 * mfu:.2f}%" if mfu is not None
+                     else " mfu_proxy=n/a")
+                  + " — the per-step intensity the width-scaling model "
+                    "assumes; projection band unchanged by this row")
+        print()
 
     times = parse_batch_times(args.log)
 

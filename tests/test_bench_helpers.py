@@ -98,7 +98,7 @@ def test_fallback_env_strip_covers_workload_knobs():
                  "MPLC_TPU_COALITIONS_PER_DEVICE", "MPLC_TPU_NO_SLOTS",
                  "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
                  "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
-                 "MPLC_TPU_SYNTH_SCALE"):
+                 "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SYNTH_SCALE"):
         assert knob in src_replay, f"{knob} missing from replay refusal"
         assert knob in src_spawn, f"{knob} missing from fallback env strip"
 
@@ -152,13 +152,29 @@ def test_importing_bench_leaves_env_alone(monkeypatch):
     assert "MPLC_TPU_SYNTH_NOISE" not in os.environ
 
 
-def _write_record(root, sub, metric, value=2133.0, vs=45.0, **extra):
+def _write_record(root, sub, metric, value=2133.0, vs=45.0, config="1",
+                  **extra):
     d = root / "perf" / sub
     d.mkdir(parents=True, exist_ok=True)
     rec = {"metric": metric, "value": value, "unit": "s", "vs_baseline": vs}
     rec.update(extra)
-    (d / "config1.json").write_text(__import__("json").dumps(rec))
-    return d / "config1.json"
+    (d / f"config{config}.json").write_text(__import__("json").dumps(rec))
+    return d / f"config{config}.json"
+
+
+_ALL_REPLAY_KNOBS = (
+    "BENCH_CONFIG", "BENCH_PARTNERS", "BENCH_EPOCHS", "BENCH_DATASET",
+    "BENCH_METHOD", "BENCH_METRIC_SUFFIX", "BENCH_DTYPE",
+    "MPLC_TPU_SYNTH_SCALE", "MPLC_TPU_SLOT_POW2", "MPLC_TPU_SLOT_MERGE",
+    "MPLC_TPU_BATCH_CAP_CEILING", "MPLC_TPU_NO_SLOTS",
+    "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_COALITIONS_PER_DEVICE",
+    "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_PIPELINE_BATCHES",
+    "MPLC_TPU_STEP_WIDTH_MULT")
+
+
+def _clean_replay_env(monkeypatch):
+    for knob in _ALL_REPLAY_KNOBS:
+        monkeypatch.delenv(knob, raising=False)
 
 
 def test_replay_emits_newest_valid_record(tmp_path, monkeypatch, capsys):
@@ -169,12 +185,7 @@ def test_replay_emits_newest_valid_record(tmp_path, monkeypatch, capsys):
     import os
     import time
 
-    for knob in ("BENCH_CONFIG", "BENCH_PARTNERS", "BENCH_EPOCHS",
-                 "BENCH_DATASET", "BENCH_METRIC_SUFFIX", "BENCH_DTYPE",
-                 "MPLC_TPU_SYNTH_SCALE", "MPLC_TPU_SLOT_POW2",
-                 "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_BATCH_CAP_CEILING",
-                 "MPLC_TPU_PIPELINE_BATCHES", "MPLC_TPU_EVAL_CHUNK"):
-        monkeypatch.delenv(knob, raising=False)
+    _clean_replay_env(monkeypatch)
     old = _write_record(tmp_path, "r4",
                         "exact_shapley_mnist_10partners_8epochs_wallclock",
                         value=2133.283, vs=45.192)
@@ -201,15 +212,8 @@ def test_replay_refuses_nondefault_workloads(tmp_path, monkeypatch, capsys):
     makes the cached full-scale record a DIFFERENT workload: no replay."""
     _write_record(tmp_path, "r5",
                   "exact_shapley_mnist_10partners_8epochs_wallclock")
-    for knob in ("BENCH_CONFIG", "BENCH_PARTNERS", "BENCH_EPOCHS",
-                 "BENCH_DATASET", "BENCH_METRIC_SUFFIX", "BENCH_DTYPE",
-                 "MPLC_TPU_SYNTH_SCALE", "MPLC_TPU_SLOT_POW2",
-                 "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_BATCH_CAP_CEILING",
-                 "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
-                 "MPLC_TPU_COALITIONS_PER_DEVICE", "MPLC_TPU_EVAL_CHUNK",
-                 "MPLC_TPU_PIPELINE_BATCHES"):
-        monkeypatch.delenv(knob, raising=False)
-    for knob, bad in (("BENCH_EPOCHS", "2"), ("BENCH_CONFIG", "3"),
+    _clean_replay_env(monkeypatch)
+    for knob, bad in (("BENCH_EPOCHS", "2"), ("BENCH_CONFIG", "7"),
                       ("BENCH_PARTNERS", "6"), ("BENCH_DATASET", "titanic"),
                       ("MPLC_TPU_SYNTH_SCALE", "0.25"),
                       ("MPLC_TPU_SLOT_POW2", "1"), ("BENCH_DTYPE", "float32"),
@@ -223,6 +227,11 @@ def test_replay_refuses_nondefault_workloads(tmp_path, monkeypatch, capsys):
                       ("MPLC_TPU_PIPELINE_BATCHES", "0"),
                       ("MPLC_TPU_SLOT_MERGE", "0"),
                       ("MPLC_TPU_BATCH_CAP_CEILING", "32"),
+                      # the wide-step deviation mode trains a DIFFERENT
+                      # trajectory even at its parity value when set —
+                      # any SET value refuses, like the other knobs
+                      ("MPLC_TPU_STEP_WIDTH_MULT", "2"),
+                      ("MPLC_TPU_STEP_WIDTH_MULT", "1"),
                       ("BENCH_METRIC_SUFFIX", "_x")):
         monkeypatch.setenv(knob, bad)
         assert bench._replay_cached_tpu_result(str(tmp_path)) is False, knob
@@ -235,16 +244,9 @@ def test_replay_skips_malformed_records(tmp_path, monkeypatch, capsys):
     skipped rather than crashing the fallback path."""
     import json
 
-    for knob in ("BENCH_CONFIG", "BENCH_PARTNERS", "BENCH_EPOCHS",
-                 "BENCH_DATASET", "BENCH_METRIC_SUFFIX", "BENCH_DTYPE",
-                 "MPLC_TPU_SYNTH_SCALE", "MPLC_TPU_SLOT_POW2",
-                 "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_BATCH_CAP_CEILING",
-                 "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_SHARDS",
-                 "MPLC_TPU_COALITIONS_PER_DEVICE", "MPLC_TPU_EVAL_CHUNK",
-                 "MPLC_TPU_PIPELINE_BATCHES"):
-        # the tests' conftest sets MPLC_TPU_SYNTH_SCALE ambiently — the
-        # gate must see the driver's clean default env here
-        monkeypatch.delenv(knob, raising=False)
+    # the tests' conftest sets MPLC_TPU_SYNTH_SCALE ambiently — the
+    # gate must see the driver's clean default env here
+    _clean_replay_env(monkeypatch)
     d = tmp_path / "perf" / "r5"
     d.mkdir(parents=True)
     (d / "config1.json").write_text(
@@ -258,3 +260,56 @@ def test_replay_skips_malformed_records(tmp_path, monkeypatch, capsys):
     assert bench._replay_cached_tpu_result(str(tmp_path)) is True
     rec = json.loads(capsys.readouterr().out.strip())
     assert rec["metric"].endswith("_cached")
+
+
+def test_replay_accepts_config_2_to_5_shapes(tmp_path, monkeypatch, capsys):
+    """The cached-replay gate covers every driver config, not just the
+    north star: a default-shaped config-N run replays the newest real TPU
+    config<N>.json record whose metric matches that config's workload."""
+    import json
+
+    shapes = {"2": "tmcs_cifar10_5partners_8epochs_wallclock",
+              "3": "is_lin_s_mnist_10partners_8epochs_wallclock",
+              "4": "smcs_imdb_4partners_8epochs_wallclock",
+              "5": "tmcs_cifar10_8partners_8epochs_wallclock"}
+    for cfg, metric in shapes.items():
+        _clean_replay_env(monkeypatch)
+        _write_record(tmp_path, "r5", metric, value=100.0 + float(cfg),
+                      vs=10.0, config=cfg)
+        monkeypatch.setenv("BENCH_CONFIG", cfg)
+        assert bench._replay_cached_tpu_result(str(tmp_path)) is True, cfg
+        rec = json.loads(capsys.readouterr().out.strip())
+        assert rec["metric"] == metric + "_cached"
+        assert rec["value"] == 100.0 + float(cfg)
+
+
+def test_replay_config_shapes_refuse_cross_config_and_method(
+        tmp_path, monkeypatch, capsys):
+    """Strictness parity with the config-1 gate: a config-2 record never
+    replays for a config-3 run (per-config file + metric prefix), ANY set
+    BENCH_METHOD refuses for configs 2-5 (a method change is a different
+    workload, even re-stating the default), and the workload-knob refusal
+    applies identically."""
+    _clean_replay_env(monkeypatch)
+    _write_record(tmp_path, "r5", "tmcs_cifar10_5partners_8epochs_wallclock",
+                  config="2")
+    # config 3 must not pick up the config-2 record
+    monkeypatch.setenv("BENCH_CONFIG", "3")
+    assert bench._replay_cached_tpu_result(str(tmp_path)) is False
+    # a config-2 record whose metric is another workload's is skipped too
+    _write_record(tmp_path, "r6", "is_reg_s_mnist_10partners_8epochs_wallclock",
+                  config="3")
+    assert bench._replay_cached_tpu_result(str(tmp_path)) is False
+
+    monkeypatch.setenv("BENCH_CONFIG", "2")
+    assert bench._replay_cached_tpu_result(str(tmp_path)) is True
+    capsys.readouterr()
+    for knob, bad in (("BENCH_METHOD", "TMCS"),   # even the default refuses
+                      ("BENCH_METHOD", "ITMCS"),
+                      ("BENCH_EPOCHS", "2"),
+                      ("MPLC_TPU_STEP_WIDTH_MULT", "2"),
+                      ("MPLC_TPU_SLOT_MERGE", "0")):
+        monkeypatch.setenv(knob, bad)
+        assert bench._replay_cached_tpu_result(str(tmp_path)) is False, knob
+        monkeypatch.delenv(knob)
+    assert capsys.readouterr().out.strip() == ""

@@ -227,10 +227,18 @@ def test_slot_execution_matches_masked(small_logreg_problem, slot_count, ids):
 
 
 def test_slot_config_guards():
+    # the seq family joined fedavg as slot-executable (seq slot epochs);
+    # lflip (per-partner theta state is [P]-indexed) and single do not
+    assert TrainConfig(approach="seqavg", slot_count=2).slot_count == 2
+    assert TrainConfig(approach="seq-pure", slot_count=2).slot_count == 2
     with pytest.raises(ValueError):
-        TrainConfig(approach="seqavg", slot_count=2)
+        TrainConfig(approach="lflip", slot_count=2)
+    with pytest.raises(ValueError):
+        TrainConfig(approach="single", slot_count=2)
     with pytest.raises(ValueError):
         TrainConfig(approach="fedavg", slot_count=2, partner_axis="part")
+    with pytest.raises(ValueError):
+        TrainConfig(step_width_mult=0)
 
 
 # -- approach classes over a real scenario ----------------------------------
@@ -282,6 +290,26 @@ def test_single_partner_class(logreg_class_scenario):
     mpl = SinglePartnerLearning(sc, partner=sc.partners_list[0])
     score = mpl.fit()
     assert 0.0 <= score <= 1.0
+
+
+def test_single_partner_class_stages_only_its_partner(logreg_class_scenario):
+    """The class path's analogue of the engine's sliced-singles rule: a
+    SinglePartnerLearning over a multi-partner scenario must stage a
+    [1, n_own, ...] tensor — its own partner's rows only, never the whole
+    scenario's stacked axis padded to the LARGEST partner."""
+    sc = logreg_class_scenario
+    # pick a partner that is NOT the largest, so a regression that stages
+    # the full scenario (P rows, Nmax = max partner size) fails loudly on
+    # both axes
+    partner = min(sc.partners_list, key=lambda p: len(p.x_train))
+    assert len(sc.partners_list) > 1
+    assert len(partner.x_train) < max(len(p.x_train)
+                                      for p in sc.partners_list)
+    mpl = SinglePartnerLearning(sc, partner=partner)
+    stacked, _val, _test = mpl._stage()
+    assert stacked.x.shape[0] == 1          # P = 1, not the scenario's P
+    assert stacked.x.shape[1] == len(partner.x_train)  # own Nmax
+    assert int(stacked.sizes[0]) == len(partner.x_train)
 
 
 @pytest.mark.slow

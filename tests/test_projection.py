@@ -199,3 +199,24 @@ def test_headline_projection_number_is_stable():
             base = t16[slot_w]
         total += nb * base * r(per_dev_w) / r(16)
     assert 280 <= total <= 300, total
+
+
+def test_telemetry_compute_row_loads_and_degrades(tmp_path):
+    """load_telemetry_compute reads the sweep report's MFU-proxy row from
+    a bench sidecar; pre-compute-schema sidecars load as {} (the
+    projection prints nothing extra) instead of failing."""
+    import json
+    new = tmp_path / "telemetry_config1.json"
+    new.write_text(json.dumps({
+        "metric": "m",
+        "report": {"wallclock": {"evaluate_s": 290.0},
+                   "compute": {"train_samples": 1000, "partner_passes": 40,
+                               "model_flops_per_s": 7.5e12,
+                               "mfu_proxy": 0.038}}}))
+    c = proj.load_telemetry_compute(str(new))
+    assert c["train_samples"] == 1000
+    assert c["mfu_proxy"] == 0.038
+    old = tmp_path / "telemetry_old.json"
+    old.write_text(json.dumps({
+        "metric": "m", "report": {"wallclock": {"evaluate_s": 290.0}}}))
+    assert proj.load_telemetry_compute(str(old)) == {}

@@ -1,0 +1,70 @@
+"""Non-gating CPU throughput microbench (fast tier): prints steps/sec and
+the MFU-proxy row for a small fedavg and a small seq sweep on every run,
+so per-step-intensity regressions are visible in ordinary CI output
+(`pytest -s`, or the captured stdout of a failing run) without waiting
+for chip time.
+
+Deliberately NON-GATING on the throughput numbers themselves — a loaded
+CI box must not flake the suite — but the accounting structure (samples,
+partner passes, a finite rate, the flops pipeline) is asserted, so a
+regression that breaks the measurement (rather than slows the code)
+still fails loudly.
+"""
+
+import numpy as np
+
+from mplc_tpu.contrib.engine import CharacteristicEngine
+from mplc_tpu.contrib.shapley import powerset_order
+from mplc_tpu.models.zoo import fwd_flops_per_sample
+from mplc_tpu.obs import trace
+from mplc_tpu.obs.report import format_report, sweep_report
+
+
+def _scenario(approach, n=4):
+    from helpers import build_scenario
+    amounts = [(i + 1) / (n * (n + 1) / 2) for i in range(n)]
+    return build_scenario(partners_count=n, amounts_per_partner=amounts,
+                          dataset_name="titanic", epoch_count=2,
+                          gradient_updates_per_pass_count=2,
+                          multi_partner_learning_approach=approach, seed=7)
+
+
+def _microbench(approach):
+    eng = CharacteristicEngine(_scenario(approach))
+    subsets = powerset_order(4)
+    with trace.collect() as recs:
+        vals = eng.evaluate(subsets)
+    assert np.isfinite(vals).all()
+    rep = sweep_report(
+        recs, flops_per_sample=fwd_flops_per_sample(eng.model.name))
+    c = rep["compute"]
+    # the accounting must be present and coherent — these gate
+    assert c["train_samples"] == eng.samples_trained > 0
+    assert c["partner_passes"] > 0
+    assert c["samples_per_s"] and np.isfinite(c["samples_per_s"])
+    assert c["model_flops_per_s"] and np.isfinite(c["model_flops_per_s"])
+    assert c["mfu_proxy"] is None  # no peak-FLOPs figure for host CPUs
+    # SGD steps executed: partner passes x gradient updates per pass
+    gup = eng.multi_pipe.trainer.cfg.gradient_updates_per_pass
+    mult = eng.multi_pipe.trainer.cfg.step_width_mult
+    steps = c["partner_passes"] * ((gup + mult - 1) // mult)
+    basis = rep["wallclock"]["evaluate_s"]
+    print(f"\n[microbench] {approach}: {steps} SGD steps, "
+          f"{steps / basis:.1f} steps/s, "
+          f"{c['samples_per_s']:.0f} samples/s, "
+          f"{c['model_flops_per_s'] / 1e6:.2f} MFLOP/s model compute "
+          f"(CPU mesh; MFU-proxy n/a without a peak figure)")
+    print(format_report(rep))
+    return rep
+
+
+def test_cpu_throughput_microbench_fedavg():
+    rep = _microbench("fedavg")
+    # fedavg routes through slot execution: no multi bucket may exceed
+    # slot_count=4 passes per coalition-minibatch
+    for row in rep["per_width"]:
+        assert row["slot_count"] is None or row["slot_count"] <= 4
+
+
+def test_cpu_throughput_microbench_seq():
+    _microbench("seq-pure")
