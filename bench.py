@@ -47,6 +47,12 @@ overlap, MPLC_TPU_BATCH_CAP_CEILING to lift the batch-cap autotune past
 MPLC_TPU_SYNTH_SCALE for smaller data on CPU smoke runs,
 MPLC_TPU_SYNTH_NOISE (default 0.75 here: accuracy must not saturate, or
 every Shapley value degenerates to 1/N — BENCH_r02's flaw).
+Fault tolerance (mplc_tpu/faults.py + the engine's recovery ladder):
+MPLC_TPU_MAX_RETRIES / MPLC_TPU_RETRY_BACKOFF_SEC for transient-failure
+retry, MPLC_TPU_MAX_CAP_HALVINGS for the OOM degradation ladder,
+MPLC_TPU_FAULT_PLAN to inject deterministic faults. The telemetry sidecar
+records a top-level "degraded" flag plus the report's resilience row, so
+a number earned on a degraded run is never mistaken for a clean one.
 """
 
 import json
@@ -196,15 +202,26 @@ def _replay_cached_tpu_result(repo_root: str | None = None) -> bool:
     # (MPLC_TPU_EVAL_CHUNK changes the compiled eval program and the
     # memory-derived batch cap, so it shapes the workload too; any SET
     # value refuses, so the pipelining opt-out "0" and merge opt-out "0"
-    # also block replay of the default-workload number)
+    # also block replay of the default-workload number; the fault-tolerance
+    # knobs reshape the run's schedule — injected faults, retry sleeps, cap
+    # degradation — so a clean cached number must not stand in for them)
     for knob in ("BENCH_DTYPE", "MPLC_TPU_BATCH_CAP_CEILING",
                  "MPLC_TPU_COALITIONS_PER_DEVICE",
-                 "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_NO_SLOTS",
+                 "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_FAULT_PLAN",
+                 "MPLC_TPU_MAX_CAP_HALVINGS", "MPLC_TPU_MAX_RETRIES",
+                 "MPLC_TPU_NO_SLOTS",
                  "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
+                 "MPLC_TPU_RETRY_BACKOFF_SEC",
                  "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
                  "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SYNTH_SCALE"):
         if os.environ.get(knob):
             return False
+    # MPLC_TPU_SYNTH_NOISE is always set by the time this runs (main()
+    # setdefaults the bench's own 0.75 before probing devices), so the
+    # any-set rule above would always refuse; only a NON-default value
+    # reshapes the synthetic data into a different workload
+    if os.environ.get("MPLC_TPU_SYNTH_NOISE", "0.75") != "0.75":
+        return False
     import glob
     repo = repo_root or os.path.dirname(os.path.abspath(__file__))
     best = None
@@ -270,14 +287,23 @@ def _spawn_cpu_fallback() -> int:
     # watchdog, which is deliberately off on CPU.
     for knob in ("BENCH_DTYPE", "MPLC_TPU_BATCH_CAP_CEILING",
                  "MPLC_TPU_COALITIONS_PER_DEVICE",
-                 "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_NO_SLOTS",
+                 "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_FAULT_PLAN",
+                 "MPLC_TPU_MAX_CAP_HALVINGS", "MPLC_TPU_MAX_RETRIES",
+                 "MPLC_TPU_NO_SLOTS",
                  "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
+                 "MPLC_TPU_RETRY_BACKOFF_SEC",
                  "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
                  "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SYNTH_SCALE",
+                 # the child's main() re-sets the canonical 0.75 — an
+                 # inherited custom noise would reshape the fallback number
+                 "MPLC_TPU_SYNTH_NOISE",
                  "BENCH_STALL_TIMEOUT", "BENCH_INIT_TIMEOUT",
                  # the child writes its own _cpu_fallback-suffixed sidecar;
                  # inheriting an explicit path would race the parent's file
-                 "BENCH_TELEMETRY_FILE", "MPLC_TPU_TRACE_FILE"):
+                 # (and a device-profile dir makes no sense for the CPU
+                 # child either)
+                 "BENCH_TELEMETRY_FILE", "MPLC_TPU_TRACE_FILE",
+                 "MPLC_TPU_PROFILE_DIR"):
         env.pop(knob, None)
     env.update(
         # A clean PYTHONPATH drops the ambient accelerator registration,
@@ -543,6 +569,16 @@ def _write_telemetry(payload: dict, repo_root: str | None = None) -> None:
               flush=True)
 
 
+def _degraded_run(rep: dict) -> bool:
+    """True when the sweep recovered from faults rather than running
+    clean — retries, OOM cap halvings, or CPU-degraded batches. Recorded
+    top-level in the telemetry sidecar so BENCH_*.json says whether a
+    number was earned on a degraded run without digging into the report."""
+    r = rep.get("resilience") or {}
+    return bool(r.get("retries") or r.get("cap_halvings")
+                or r.get("cpu_batches"))
+
+
 def _emit(metric, elapsed, baseline):
     if _watchdog_fired.is_set():
         # The stall watchdog already took over (its fallback child owns
@@ -605,7 +641,8 @@ def bench_exact_shapley(epochs, dtype):
     rep = sweep_report(tele, flops_per_sample=flops, peak_flops=fleet_peak)
     print(format_report(rep), file=sys.stderr, flush=True)
     _write_telemetry({"metric": metric, "wallclock_s": elapsed,
-                      "devices": _ndev(), "report": rep})
+                      "devices": _ndev(), "degraded": _degraded_run(rep),
+                      "report": rep})
     _emit(metric, elapsed, _baseline_seconds(dataset, epochs, B))
 
 
@@ -663,7 +700,8 @@ def _bench_method(dataset_name, n_partners, method, epochs, dtype,
     rep = sweep_report(tele, flops_per_sample=flops, peak_flops=fleet_peak)
     print(format_report(rep), file=sys.stderr, flush=True)
     _write_telemetry({"metric": metric, "wallclock_s": elapsed,
-                      "devices": _ndev(), "report": rep})
+                      "devices": _ndev(), "degraded": _degraded_run(rep),
+                      "report": rep})
     _emit(metric, elapsed, _baseline_seconds(dataset_name, epochs, calls))
 
 

@@ -86,6 +86,24 @@ def _env_positive_int(name: str, default: int) -> int:
     return value
 
 
+def _env_nonneg_float(name: str, default: float) -> float:
+    """Same warn+fallback contract as `_env_positive_int`, for knobs where
+    zero is meaningful (e.g. a retry backoff of 0 s in the fast tier)."""
+    raw = _os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = float(raw)
+        if value < 0 or value != value:  # NaN guard
+            raise ValueError(raw)
+    except ValueError:
+        import warnings
+        warnings.warn(f"{name}={raw!r} is not a non-negative number; "
+                      f"falling back to {default}", stacklevel=2)
+        return default
+    return value
+
+
 EVAL_CHUNK_SIZE = _env_positive_int("MPLC_TPU_EVAL_CHUNK", 2048)
 
 # Fused wide-step mode (MPLC_TPU_STEP_WIDTH_MULT=k): fold k consecutive
@@ -106,3 +124,58 @@ STEP_WIDTH_MULT = _env_positive_int("MPLC_TPU_STEP_WIDTH_MULT", 1)
 # raising on chips with HBM headroom — override with
 # MPLC_TPU_BATCH_CAP_CEILING (read at cap-computation time, not import).
 BATCH_CAP_CEILING_ENV = "MPLC_TPU_BATCH_CAP_CEILING"
+
+# Fault-tolerance knobs (contrib/engine.py + faults.py), all read at
+# ENGINE-CONSTRUCTION time via the warn+fallback parsers above — a typo'd
+# value degrades to the default instead of killing an hours-long sweep:
+#   MPLC_TPU_MAX_RETRIES        transient-failure retries per batch (3)
+#   MPLC_TPU_RETRY_BACKOFF_SEC  base of the exponential backoff (0.5 s,
+#                               doubling per attempt, capped below)
+#   MPLC_TPU_MAX_CAP_HALVINGS   OOM cap-halvings before the engine routes
+#                               remaining batches through the per-batch
+#                               CPU path (3)
+#   MPLC_TPU_FAULT_PLAN         deterministic fault-injection plan
+#                               (grammar in faults.py)
+MAX_RETRIES_ENV = "MPLC_TPU_MAX_RETRIES"
+RETRY_BACKOFF_ENV = "MPLC_TPU_RETRY_BACKOFF_SEC"
+MAX_CAP_HALVINGS_ENV = "MPLC_TPU_MAX_CAP_HALVINGS"
+RETRY_BACKOFF_CAP_SEC = 30.0  # bound on a single backoff sleep
+
+# ---------------------------------------------------------------------------
+# Env-knob registry. EVERY `MPLC_TPU_*` env var the framework reads must be
+# registered here with its class — tests/test_knob_hygiene.py greps the
+# source tree and fails on an unregistered knob, and checks the class
+# obligations below. PRs 1-3 each extended bench.py's two knob lists by
+# hand; this registry makes forgetting one a test failure, not a silently
+# wrong cached-replay/fallback number.
+#
+#   "workload": shapes the sweep or its measurement. MUST appear in both
+#       bench._replay_cached_tpu_result's refusal list (a cached TPU
+#       number is a DIFFERENT workload under any non-default value) and
+#       bench._spawn_cpu_fallback's env-strip list (the reduced CPU child
+#       must not inherit parent tuning).
+#   "sidecar": observability/output plumbing only. MUST be stripped from
+#       the CPU-fallback child (it writes its own sidecars) but does not
+#       refuse replay.
+#   "ambient": environment plumbing (data locations) with no bench
+#       obligations.
+ENV_KNOBS = {
+    "MPLC_TPU_BATCH_CAP_CEILING": "workload",
+    "MPLC_TPU_COALITIONS_PER_DEVICE": "workload",
+    "MPLC_TPU_EVAL_CHUNK": "workload",
+    "MPLC_TPU_FAULT_PLAN": "workload",
+    "MPLC_TPU_MAX_CAP_HALVINGS": "workload",
+    "MPLC_TPU_MAX_RETRIES": "workload",
+    "MPLC_TPU_NO_SLOTS": "workload",
+    "MPLC_TPU_PARTNER_SHARDS": "workload",
+    "MPLC_TPU_PIPELINE_BATCHES": "workload",
+    "MPLC_TPU_RETRY_BACKOFF_SEC": "workload",
+    "MPLC_TPU_SLOT_MERGE": "workload",
+    "MPLC_TPU_SLOT_POW2": "workload",
+    "MPLC_TPU_STEP_WIDTH_MULT": "workload",
+    "MPLC_TPU_SYNTH_NOISE": "workload",
+    "MPLC_TPU_SYNTH_SCALE": "workload",
+    "MPLC_TPU_PROFILE_DIR": "sidecar",
+    "MPLC_TPU_TRACE_FILE": "sidecar",
+    "MPLC_TPU_DATA_DIR": "ambient",
+}

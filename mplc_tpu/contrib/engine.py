@@ -23,11 +23,21 @@ TPU-native replacement and the performance core of the framework:
 Parity note: 1-partner coalitions run through the dedicated `single` trainer
 (persistent optimizer + Keras-style early stopping), mirroring the
 reference's SinglePartnerLearning routing (contributivity.py:107-112).
+
+Fault tolerance: every dispatch/harvest boundary runs under a recovery
+ladder — transient-failure retry with bounded backoff, OOM cap halving
+with re-bucketing of the remaining subsets, and a terminal per-batch CPU
+path — plus checksummed, fsync'd cache autosaves for crash/resume. The
+invariant is that recovery never changes v(S): retried/re-bucketed/CPU
+batches train the same per-coalition rng-fold streams (doc/documentation.md
+"Robustness & fault injection"; deterministic injection via
+MPLC_TPU_FAULT_PLAN, faults.py).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import time
 
@@ -36,12 +46,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .. import constants
+from .. import constants, faults
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..data.partition import StackedPartners, stack_eval_set
 from ..mpl.engine import EvalSet, MplTrainer, TrainConfig
 from ..parallel.mesh import coalition_sharding, make_2d_mesh
+
+logger = logging.getLogger("mplc_tpu")
 
 
 def _bucket_size(n: int, n_dev: int, cap_per_dev: int) -> int:
@@ -51,6 +63,14 @@ def _bucket_size(n: int, n_dev: int, cap_per_dev: int) -> int:
     while b < min(n, cap):
         b *= 2
     return min(b, cap)
+
+
+class CacheIntegrityError(ValueError):
+    """A coalition cache file is unreadable AS A FILE — truncated write,
+    corrupted bytes, checksum mismatch, missing payload keys. Distinct
+    from the fingerprint ValueError (a VALID cache describing a different
+    game): resume paths may quarantine-and-continue on integrity failures
+    but must still refuse fingerprint mismatches."""
 
 
 @jax.jit
@@ -376,6 +396,32 @@ class CharacteristicEngine:
         # whole call.
         self.progress = None
 
+        # Fault tolerance (faults.py). All knobs are read HERE, once per
+        # engine, with warn+fallback parses: a typo'd value degrades to the
+        # default instead of killing an hours-long sweep mid-run. Recovery
+        # must never change v(S) — every path below re-runs batches through
+        # the same per-coalition rng-fold streams, so recovered sweeps are
+        # bit-identical to fault-free ones (equality-tested in
+        # tests/test_faults.py).
+        self._max_retries = constants._env_positive_int(
+            constants.MAX_RETRIES_ENV, 3)
+        self._retry_backoff = constants._env_nonneg_float(
+            constants.RETRY_BACKOFF_ENV, 0.5)
+        self._max_cap_halvings = constants._env_positive_int(
+            constants.MAX_CAP_HALVINGS_ENV, 3)
+        # rungs already taken down the OOM ladder: every halving applies to
+        # ALL subsequent _device_batch_cap computations, so re-bucketing
+        # the remaining subsets reuses the ordinary width machinery
+        self._cap_halvings = 0
+        self._cpu_degraded = False
+        self._cpu_data = None  # lazily host-pinned copy for the CPU path
+        # 1-based device-batch ordinal (dispatch order, shared across the
+        # engine's paths): the unit the fault plan addresses. A RETRY of a
+        # batch keeps its ordinal, so `transient@batchK` means "batch K
+        # fails once, then its bit-identical retry goes through".
+        self._batch_ordinal = 0
+        self._faults = faults.FaultInjector.from_env()
+
         self._sharding = coalition_sharding()
 
     # ------------------------------------------------------------------
@@ -467,11 +513,16 @@ class CharacteristicEngine:
         window) would overflow ~50% of device memory. Override with
         MPLC_TPU_COALITIONS_PER_DEVICE (a malformed value warns and falls
         back to the autotune instead of crashing mid-sweep).
+
+        Every RESOURCE_EXHAUSTED recovery (`_degrade_cap`) halves the
+        result — env override included: the operator's number was measured
+        on a non-OOMing run, and the ladder exists precisely because that
+        measurement stopped holding.
         """
         env_cap = constants._env_positive_int(
             "MPLC_TPU_COALITIONS_PER_DEVICE", 0)
         if env_cap:
-            return env_cap
+            return max(1, env_cap >> self._cap_halvings)
         if getattr(self, "_param_bytes", None) is None:
             shapes = jax.eval_shape(self.model.init, jax.random.PRNGKey(0))
             self._param_bytes = sum(
@@ -504,7 +555,7 @@ class CharacteristicEngine:
         ceiling = constants._env_positive_int(
             constants.BATCH_CAP_CEILING_ENV,
             constants.MAX_COALITIONS_PER_DEVICE_BATCH)
-        return min(ceiling, fit)
+        return max(1, min(ceiling, fit) >> self._cap_halvings)
 
     def _slot_pipe(self, k: int) -> BatchedTrainerPipeline:
         if k not in self._slot_pipes:
@@ -522,25 +573,130 @@ class CharacteristicEngine:
                 self.single_pipe.trainer, b)
         return self._singles_pipes[b]
 
+    def _retry_transient(self, op, site: str):
+        """Run `op` with bounded exponential backoff on transient runtime
+        failures (`faults.is_transient`): up to MPLC_TPU_MAX_RETRIES
+        retries. The per-coalition rng-fold streams make a re-dispatched
+        batch bit-identical to the failed attempt, so a retry can never
+        change v(S). OOM and non-transient errors propagate."""
+        attempt = 0
+        while True:
+            try:
+                return op()
+            except Exception as e:
+                if not faults.is_transient(e) or attempt >= self._max_retries:
+                    raise
+                attempt += 1
+                self._backoff(site, attempt, e)
+
+    def _fetch_with_retry(self, fetch, meta):
+        """Harvest with transient recovery: a failed result fetch
+        re-dispatches the SAME batch (same rng streams — bit-identical)
+        via `meta["redispatch"]` and fetches again, up to the retry
+        budget. The fault plan's harvest boundary sits here. The
+        re-dispatch runs INSIDE the try: during a correlated outage the
+        re-dispatch itself fails transiently too, and that failure must
+        consume a retry, not escape the ladder."""
+        attempt = 0
+        while True:
+            try:
+                if fetch is None:
+                    fetch = meta["redispatch"]()
+                self._faults.check("harvest", meta.get("ordinal", 0))
+                return fetch()
+            except Exception as e:
+                if (not faults.is_transient(e)
+                        or meta.get("redispatch") is None
+                        or attempt >= self._max_retries):
+                    raise
+                attempt += 1
+                self._backoff("harvest", attempt, e)
+                fetch = None  # re-dispatch on the next attempt
+
+    def _backoff(self, site: str, attempt: int, err: BaseException) -> None:
+        delay = min(self._retry_backoff * (2 ** (attempt - 1)),
+                    constants.RETRY_BACKOFF_CAP_SEC)
+        obs_metrics.counter("engine.retries").inc()
+        obs_metrics.counter("engine.backoff_sec").inc(delay)
+        obs_trace.event("engine.retry", site=site, attempt=attempt,
+                        backoff_sec=delay, error=str(err)[:200])
+        logger.warning(
+            "transient %s failure (attempt %d/%d, backing off %.2f s): %s",
+            site, attempt, self._max_retries, delay, err)
+        if delay:
+            time.sleep(delay)
+
+    def _degrade_cap(self, err: BaseException) -> None:
+        """One rung down the OOM ladder: halve the per-device coalition
+        cap (every later `_device_batch_cap` call sees it), or — past
+        MPLC_TPU_MAX_CAP_HALVINGS rungs — flip the engine into the
+        per-batch CPU path for everything still missing. Already-harvested
+        v(S) values are kept either way: the memo cache makes the
+        re-bucketing free."""
+        self._cap_halvings += 1
+        obs_metrics.counter("engine.cap_halvings").inc()
+        if self._cap_halvings > self._max_cap_halvings:
+            self._cpu_degraded = True
+            obs_trace.event("engine.degrade", action="cpu_fallback",
+                            halvings=self._cap_halvings, error=str(err)[:200])
+            logger.warning(
+                "device OOM after %d cap halvings — routing the remaining "
+                "coalition batches through the per-batch CPU path (%s)",
+                self._max_cap_halvings, err)
+        else:
+            obs_trace.event("engine.degrade", action="halve_cap",
+                            halvings=self._cap_halvings, error=str(err)[:200])
+            logger.warning(
+                "device OOM — halving the per-device coalition cap (halving "
+                "%d of %d) and re-bucketing the remaining subsets (%s)",
+                self._cap_halvings, self._max_cap_halvings, err)
+
+    def _record_or_recover(self, prev, per_partner, slot_count, pipe) -> None:
+        """`_record_group` plus the harvest-side OOM ladder: when FETCHING
+        a batch's results exhausts device memory, the batch's coalitions
+        re-run through `_run_batch` at the degraded cap (or the CPU path)
+        instead of killing the sweep. Transient fetch failures were
+        already retried inside `_record_group`; anything else propagates."""
+        try:
+            self._record_group(*prev, per_partner, slot_count)
+        except Exception as e:
+            if not faults.is_oom(e):
+                raise
+            self._degrade_cap(e)
+            if self._cpu_degraded and getattr(pipe, "coal_devices", None):
+                raise  # no CPU path for the partner-sharded 2-D programs
+            redo = [s for s in prev[0] if s not in self.charac_fct_values]
+            if redo:
+                self._run_batch(redo, pipe, slot_count)
+
     def _run_batch(self, subsets: list[tuple], pipe,
                    slot_count: int | None = None) -> None:
         # overlap is only possible when the pipe dispatches without host
         # decisions inside (no mid-run ES sync) — otherwise pipelining
         # degenerates to the sequential path and must not halve the cap
         overlap = self._pipeline_batches and pipe.dispatches_async
-        if getattr(pipe, "coal_devices", None):
-            n_dev = pipe.coal_devices          # 2-D mesh: coal axis only
-            # each device holds only partners_count / part_shards partner
-            # model copies — cap on the LOCAL count, not the global one
-            cap = self._device_batch_cap(pipe._local_partners, overlap)
-        else:
-            n_dev = max(self._sharding.num_devices if self._sharding else 1, 1)
-            cap = self._device_batch_cap(slot_count, overlap)
-        # ONE bucket width for the whole call (the tail group pads up to it
-        # rather than compiling its own smaller-width program) — so a warm-up
-        # pass over min(len, n_dev*cap) subsets per size compiles exactly
-        # the programs a full sweep executes.
-        b = _bucket_size(min(len(subsets), n_dev * cap), n_dev, cap)
+        is2d = bool(getattr(pipe, "coal_devices", None))
+
+        def bucket_width() -> int:
+            # ONE bucket width for the whole call (the tail group pads up
+            # to it rather than compiling its own smaller-width program) —
+            # so a warm-up pass over min(len, n_dev*cap) subsets per size
+            # compiles exactly the programs a full sweep executes.
+            # Recomputed only when the OOM ladder moved, so fault-free runs
+            # keep the single deterministic width per call.
+            if is2d:
+                n_dev = pipe.coal_devices      # 2-D mesh: coal axis only
+                # each device holds only partners_count / part_shards
+                # partner model copies — cap on the LOCAL count
+                cap = self._device_batch_cap(pipe._local_partners, overlap)
+            else:
+                n_dev = max(
+                    self._sharding.num_devices if self._sharding else 1, 1)
+                cap = self._device_batch_cap(slot_count, overlap)
+            return _bucket_size(min(len(subsets), n_dev * cap), n_dev, cap)
+
+        b = bucket_width()
+        halvings_seen = self._cap_halvings
         per_partner = (self._epoch_samples_single
                        if pipe is self.single_pipe
                        else self._epoch_samples_multi)
@@ -564,28 +720,78 @@ class CharacteristicEngine:
         try:
             i = 0
             while i < len(subsets):
+                if self._cpu_degraded and not is2d:
+                    # OOM ladder exhausted: drain the in-flight batch
+                    # (its own fetch may OOM too — the recover path routes
+                    # it through the CPU rung), then run everything left
+                    # one small CPU batch at a time
+                    if pending is not None:
+                        prev, pending = pending, None
+                        self._record_or_recover(prev, per_partner,
+                                                slot_count, pipe)
+                    self._run_groups_cpu(subsets, i, coal_all, words, n_words,
+                                         pipe, slot_count, per_partner,
+                                         passes_per_mb)
+                    return
+                if self._cap_halvings != halvings_seen:
+                    # an OOM (here or inside a harvest recovery) stepped the
+                    # ladder down: re-bucket the REMAINING subsets through
+                    # the ordinary width machinery at the degraded cap
+                    halvings_seen = self._cap_halvings
+                    b = bucket_width()
                 group = subsets[i:i + b]
                 # padding rows replicate the batch's first coalition (the
                 # same convention the old per-batch fill loop used)
                 sel = np.full(b, i, np.intp)
                 sel[:len(group)] = np.arange(i, i + len(group))
-                i += len(group)
+                self._batch_ordinal += 1
                 attrs = {"width": b, "slot_count": slot_count,
                          "coalitions": len(group), "padding": b - len(group)}
                 meta = {**attrs, "t0": time.perf_counter(),
                         "passes_per_mb": passes_per_mb,
-                        "mb_count": pipe.trainer.cfg.minibatch_count}
-                with obs_trace.span("engine.dispatch", **attrs):
-                    rngs = self._batch_rngs(words, n_words, sel)
-                    coal = jnp.asarray(coal_all[sel])
-                    if getattr(pipe, "batch_sharding", None) is not None:
-                        coal = jax.device_put(coal, pipe.batch_sharding)
-                        rngs = jax.device_put(rngs, pipe.rng_sharding)
-                    elif self._sharding is not None:
-                        coal = jax.device_put(coal, self._sharding.batch_sharding)
-                        rngs = jax.device_put(rngs, self._sharding.batch_sharding)
-                    fetch = pipe.scores_async(coal, rngs, self.stacked, self.val,
-                                              self.test, self._coalition_rng(()))
+                        "mb_count": pipe.trainer.cfg.minibatch_count,
+                        "ordinal": self._batch_ordinal}
+
+                def dispatch(sel=sel, attrs=attrs,
+                             ordinal=self._batch_ordinal):
+                    with obs_trace.span("engine.dispatch", **attrs):
+                        self._faults.check("dispatch", ordinal)
+                        rngs = self._batch_rngs(words, n_words, sel)
+                        coal = jnp.asarray(coal_all[sel])
+                        if getattr(pipe, "batch_sharding", None) is not None:
+                            coal = jax.device_put(coal, pipe.batch_sharding)
+                            rngs = jax.device_put(rngs, pipe.rng_sharding)
+                        elif self._sharding is not None:
+                            coal = jax.device_put(
+                                coal, self._sharding.batch_sharding)
+                            rngs = jax.device_put(
+                                rngs, self._sharding.batch_sharding)
+                        return pipe.scores_async(coal, rngs, self.stacked,
+                                                 self.val, self.test,
+                                                 self._coalition_rng(()))
+
+                meta["redispatch"] = dispatch
+                try:
+                    fetch = self._retry_transient(dispatch, "dispatch")
+                except Exception as e:
+                    if not faults.is_oom(e):
+                        raise
+                    # RESOURCE_EXHAUSTED at dispatch: step the ladder down
+                    # and retry THIS group (i unchanged) at the degraded
+                    # width. The finished in-flight batch is preserved
+                    # FIRST — and with async dispatch an OOM often surfaces
+                    # at ITS fetch instead, so the drain goes through the
+                    # recover path, not a bare harvest.
+                    if pending is not None:
+                        prev, pending = pending, None
+                        self._record_or_recover(prev, per_partner,
+                                                slot_count, pipe)
+                    self._degrade_cap(e)
+                    if self._cpu_degraded and is2d:
+                        raise  # 2-D takes the halving rungs but has no CPU
+                               # rung: shard_map programs need the mesh
+                    continue
+                i += len(group)
                 if overlap:
                     # harvest the PREVIOUS batch only after this one is in
                     # the device queue: the device crosses batch boundaries
@@ -596,21 +802,74 @@ class CharacteristicEngine:
                     # and throughput bookkeeping).
                     if pending is not None:
                         prev, pending = pending, None
-                        self._record_group(*prev, per_partner, slot_count)
+                        self._record_or_recover(prev, per_partner,
+                                                slot_count, pipe)
                     pending = (group, fetch, len(subsets) - i, meta)
                 else:
-                    self._record_group(group, fetch, len(subsets) - i, meta,
-                                       per_partner, slot_count)
+                    self._record_or_recover(
+                        (group, fetch, len(subsets) - i, meta),
+                        per_partner, slot_count, pipe)
+            if pending is not None:
+                # normal-exit drain: the last in-flight batch still gets
+                # the harvest-side OOM ladder (the exception-unwind drain
+                # below must preserve-and-propagate instead)
+                prev, pending = pending, None
+                self._record_or_recover(prev, per_partner, slot_count, pipe)
         finally:
             if pending is not None:
-                # the single drain point for the last in-flight batch: on
-                # normal exit this IS its harvest; when prepping/dispatching
-                # the next batch failed, it preserves the finished one
-                # (store + autosave) before unwinding. A harvest that
-                # itself raised cleared `pending` first, so it is never
-                # retried here.
+                # reached only while unwinding an exception: preserve the
+                # finished in-flight batch (store + autosave) before the
+                # unwind continues. A harvest that itself raised cleared
+                # `pending` first, so it is never retried here.
                 prev, pending = pending, None
                 self._record_group(*prev, per_partner, slot_count)
+
+    def _run_groups_cpu(self, subsets, start, coal_all, words, n_words,
+                        pipe, slot_count, per_partner, passes_per_mb) -> None:
+        """Terminal rung of the OOM ladder: train the remaining groups one
+        small batch at a time on the host CPU backend instead of
+        abandoning the run (bench's process-level fallback restarts the
+        whole workload at reduced scale; here everything already harvested
+        is kept and only the tail pays CPU speed). Row-independent vmapped
+        training makes the CPU values bit-identical to the device path's —
+        equality-tested under injected faults."""
+        cpu_dev = jax.local_devices(backend="cpu")[0]
+        if self._cpu_data is None:
+            self._cpu_data = tuple(
+                jax.tree_util.tree_map(lambda t: jax.device_put(t, cpu_dev), d)
+                for d in (self.stacked, self.val, self.test))
+        stacked, val, test = self._cpu_data
+        cap = self._device_batch_cap(slot_count, False)
+        b = _bucket_size(min(len(subsets) - start, cap), 1, cap)
+        i = start
+        while i < len(subsets):
+            group = subsets[i:i + b]
+            sel = np.full(b, i, np.intp)
+            sel[:len(group)] = np.arange(i, i + len(group))
+            i += len(group)
+            self._batch_ordinal += 1
+            attrs = {"width": b, "slot_count": slot_count,
+                     "coalitions": len(group), "padding": b - len(group),
+                     "degraded": "cpu"}
+            meta = {**attrs, "t0": time.perf_counter(),
+                    "passes_per_mb": passes_per_mb,
+                    "mb_count": pipe.trainer.cfg.minibatch_count,
+                    "ordinal": self._batch_ordinal}
+
+            def dispatch(sel=sel, attrs=attrs, ordinal=self._batch_ordinal):
+                with obs_trace.span("engine.dispatch", **attrs):
+                    self._faults.check("dispatch", ordinal)
+                    rngs = jax.device_put(
+                        self._batch_rngs(words, n_words, sel), cpu_dev)
+                    coal = jax.device_put(jnp.asarray(coal_all[sel]), cpu_dev)
+                    with jax.default_device(cpu_dev):
+                        return pipe.scores_async(coal, rngs, stacked, val,
+                                                 test, self._coalition_rng(()))
+
+            meta["redispatch"] = dispatch
+            fetch = self._retry_transient(dispatch, "dispatch")
+            self._record_group(group, fetch, len(subsets) - i, meta,
+                               per_partner, slot_count)
 
     def _record_group(self, group, fetch, remaining, meta, per_partner,
                       slot_count) -> None:
@@ -620,7 +879,7 @@ class CharacteristicEngine:
         with obs_trace.span("engine.harvest", width=meta["width"],
                             slot_count=slot_count,
                             coalitions=meta["coalitions"]):
-            accs, epochs = fetch()
+            accs, epochs = self._fetch_with_retry(fetch, meta)
         batch_epochs = 0
         batch_samples = 0
         for s, acc, ep in zip(group, accs[:len(group)], epochs[:len(group)]):
@@ -640,12 +899,20 @@ class CharacteristicEngine:
         # batch pipelining consecutive batches overlap, so these durations
         # sum to more than wall-clock — a utilization view). All host-side;
         # the only device sync is the harvest fetch that already happened.
+        extra = {}
+        if meta.get("degraded"):
+            # earned on the OOM ladder's CPU rung, not the device path —
+            # the sweep report's resilience row separates these out
+            extra["degraded"] = meta["degraded"]
+            obs_metrics.counter("engine.cpu_degraded_batches").inc()
+            obs_metrics.counter("engine.cpu_degraded_coalitions").inc(
+                len(group))
         obs_trace.event(
             "engine.batch", dur=time.perf_counter() - meta["t0"],
             width=meta["width"], slot_count=slot_count,
             coalitions=meta["coalitions"], padding=meta["padding"],
             epochs=batch_epochs, samples=batch_samples,
-            partner_passes=batch_passes)
+            partner_passes=batch_passes, **extra)
         obs_metrics.counter("engine.epochs_trained").inc(batch_epochs)
         obs_metrics.counter("engine.samples_trained").inc(batch_samples)
         obs_metrics.counter("engine.partner_passes").inc(batch_passes)
@@ -664,7 +931,15 @@ class CharacteristicEngine:
         (which the 2-D mode exists to avoid). The single trainer's rng
         streams are per-coalition, not partner-row-indexed, so the slice
         trains identically; the mask is the identity (coalition j owns
-        slice row j)."""
+        slice row j).
+
+        OOM recovery here is by RECURSION rather than _run_batch's
+        in-loop re-bucketing: the batch width is baked into the identity
+        mask and the per-width singles pipe, so after a cap halving the
+        cleanest re-bucket is a fresh call over whatever is still missing
+        (the memo cache keeps everything harvested). Like the rest of the
+        2-D mode there is no CPU rung — the ladder ends when the halvings
+        run out."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         n_dev = self._pipe2d.coal_devices
@@ -691,6 +966,31 @@ class CharacteristicEngine:
             # the identity coalition mask is batch-invariant: build and
             # place it once per call, not once per batch
             eye = jax.device_put(jnp.eye(b, dtype=jnp.float32), coal_sh)
+
+        def recover_oom(err) -> None:
+            """Step the ladder down and re-run whatever is still missing
+            through a fresh call at the degraded cap."""
+            self._degrade_cap(err)
+            if self._cpu_degraded:
+                raise err  # 2-D singles ride the halving rungs only
+            redo = [s for s in singles if s not in self.charac_fct_values]
+            if redo:
+                self._run_singles_sliced(redo)
+
+        def harvest_prev(prev) -> bool:
+            """Harvest a drained batch; on fetch-OOM recover via
+            recursion and report True (the caller must stop: everything
+            still missing — including any batch it has in flight — was
+            completed by the recursive call)."""
+            try:
+                self._record_group(*prev, self._epoch_samples_single, None)
+                return False
+            except Exception as e:
+                if not faults.is_oom(e):
+                    raise
+                recover_oom(e)
+                return True
+
         pending = None
         try:
             i = 0
@@ -699,35 +999,62 @@ class CharacteristicEngine:
                 sel = np.full(b, i, np.intp)
                 sel[:len(group)] = np.arange(i, i + len(group))
                 i += len(group)
+                self._batch_ordinal += 1
                 attrs = {"width": b, "slot_count": None,
                          "coalitions": len(group), "padding": b - len(group)}
                 meta = {**attrs, "t0": time.perf_counter(),
                         "passes_per_mb": 1,
-                        "mb_count": pipe.trainer.cfg.minibatch_count}
-                with obs_trace.span("engine.dispatch", **attrs):
-                    ids = ids_all[sel]
-                    sliced = StackedPartners(
-                        x=jax.device_put(jnp.take(self.stacked.x, ids, axis=0), rep_sh),
-                        y=jax.device_put(jnp.take(self.stacked.y, ids, axis=0), rep_sh),
-                        mask=jax.device_put(jnp.take(self.stacked.mask, ids, axis=0), rep_sh),
-                        sizes=jax.device_put(jnp.take(self.stacked.sizes, ids, axis=0), rep_sh))
-                    rngs = jax.device_put(
-                        self._batch_rngs(words, n_words, sel), coal_sh)
-                    fetch = pipe.scores_async(eye, rngs, sliced, self.val,
-                                              self.test,
-                                              self._coalition_rng(()))
+                        "mb_count": pipe.trainer.cfg.minibatch_count,
+                        "ordinal": self._batch_ordinal}
+
+                def dispatch(sel=sel, attrs=attrs,
+                             ordinal=self._batch_ordinal):
+                    with obs_trace.span("engine.dispatch", **attrs):
+                        self._faults.check("dispatch", ordinal)
+                        ids = ids_all[sel]
+                        sliced = StackedPartners(
+                            x=jax.device_put(jnp.take(self.stacked.x, ids, axis=0), rep_sh),
+                            y=jax.device_put(jnp.take(self.stacked.y, ids, axis=0), rep_sh),
+                            mask=jax.device_put(jnp.take(self.stacked.mask, ids, axis=0), rep_sh),
+                            sizes=jax.device_put(jnp.take(self.stacked.sizes, ids, axis=0), rep_sh))
+                        rngs = jax.device_put(
+                            self._batch_rngs(words, n_words, sel), coal_sh)
+                        return pipe.scores_async(eye, rngs, sliced, self.val,
+                                                 self.test,
+                                                 self._coalition_rng(()))
+
+                meta["redispatch"] = dispatch
+                try:
+                    fetch = self._retry_transient(dispatch, "dispatch")
+                except Exception as e:
+                    if not faults.is_oom(e):
+                        raise
+                    if pending is not None:
+                        prev, pending = pending, None
+                        if harvest_prev(prev):
+                            return
+                    recover_oom(e)
+                    return
                 if overlap:
                     if pending is not None:
                         prev, pending = pending, None
-                        self._record_group(*prev, self._epoch_samples_single,
-                                           None)
+                        if harvest_prev(prev):
+                            # the recursion completed every missing single;
+                            # the current in-flight fetch is abandoned (its
+                            # coalitions were retrained at the lower cap)
+                            return
                     pending = (group, fetch, len(singles) - i, meta)
                 else:
-                    self._record_group(group, fetch, len(singles) - i, meta,
-                                       self._epoch_samples_single, None)
+                    if harvest_prev((group, fetch, len(singles) - i, meta)):
+                        return
+            if pending is not None:
+                # normal-exit drain, with the harvest-side OOM rung
+                prev, pending = pending, None
+                harvest_prev(prev)
         finally:
             if pending is not None:
-                # same drain contract as _run_batch: harvest-on-exit, never
+                # same drain contract as _run_batch: reached only while
+                # unwinding an exception — harvest-on-exit, never
                 # re-harvest a batch whose fetch already raised
                 prev, pending = pending, None
                 self._record_group(*prev, self._epoch_samples_single, None)
@@ -892,9 +1219,16 @@ class CharacteristicEngine:
         }
 
     def save_cache(self, path) -> None:
-        """Persist v(S) memo + increment bookkeeping as JSON (atomic:
-        write-to-temp + rename, so an interrupted autosave never corrupts a
-        previously good cache file)."""
+        """Persist v(S) memo + increment bookkeeping as JSON, durably.
+
+        Three layers make an autosave survive hard kills: the payload
+        carries a sha256 checksum (`load_cache` verifies it, so corrupted
+        bytes can never silently poison v(S)); the temp file is flushed
+        and fsync'd BEFORE the atomic `os.replace` — without that fsync a
+        power loss can promote an empty or partial temp file over a good
+        cache despite the rename itself being atomic; and the directory
+        entry is fsync'd after the rename so the promotion is durable."""
+        import hashlib
         import json
         import os as _os
         payload = {
@@ -905,17 +1239,67 @@ class CharacteristicEngine:
             "increments_values": [[[list(k), v] for k, v in d.items()]
                                   for d in self.increments_values],
         }
+        # checksum over the payload's own serialization: verification
+        # re-derives the same bytes from the parsed document (json dict
+        # order and float repr both round-trip), so no second file or
+        # length prefix is needed. The checksum field is spliced into the
+        # already-serialized body — this runs after EVERY autosaved batch,
+        # and a second full json.dumps of a 2^P-entry memo would double
+        # the harvest path's host cost.
+        body = json.dumps(payload)
+        digest = hashlib.sha256(body.encode()).hexdigest()
+        record_text = '{"payload_sha256": "%s", %s' % (digest, body[1:])
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
-            json.dump(payload, f)
+            f.write(record_text)
+            f.flush()
+            _os.fsync(f.fileno())
         _os.replace(tmp, path)
+        try:
+            dfd = _os.open(_os.path.dirname(_os.path.abspath(str(path))),
+                           _os.O_RDONLY)
+            try:
+                _os.fsync(dfd)
+            finally:
+                _os.close(dfd)
+        except OSError:
+            pass  # platforms/filesystems without directory fsync
 
     def load_cache(self, path) -> None:
-        """Restore a saved cache; a cache from a scenario whose training
-        setup differs in ANY v(S)-relevant way raises."""
+        """Restore a saved cache.
+
+        Integrity failures — truncated or corrupt JSON, checksum
+        mismatch, missing payload keys — raise `CacheIntegrityError`, so
+        resume paths can quarantine the file and start cold
+        (scenario.py). A VALID cache whose scenario differs in ANY
+        v(S)-relevant way still raises a plain ValueError: that cache
+        describes a different game. Caches saved before the checksum
+        existed (no `payload_sha256` field) load unverified."""
+        import hashlib
         import json
-        with open(path) as f:
-            payload = json.load(f)
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            if not isinstance(payload, dict):
+                raise ValueError(
+                    f"top-level JSON is {type(payload).__name__}, not an object")
+        except ValueError as e:
+            raise CacheIntegrityError(
+                f"coalition cache {path} is corrupt or truncated: {e}") from e
+        expected = payload.pop("payload_sha256", None)
+        if expected is not None:
+            actual = hashlib.sha256(
+                json.dumps(payload).encode()).hexdigest()
+            if actual != expected:
+                raise CacheIntegrityError(
+                    f"coalition cache {path} failed its checksum (stored "
+                    f"{expected[:12]}…, recomputed {actual[:12]}…): the "
+                    "file was corrupted after it was written")
+        missing = {"fingerprint", "first_charac_fct_calls_count",
+                   "charac_fct_values", "increments_values"} - payload.keys()
+        if missing:
+            raise CacheIntegrityError(
+                f"coalition cache {path} is missing keys {sorted(missing)}")
         theirs = payload.get("fingerprint", {})
         # caches saved before the wide-step knob existed ran at the only
         # stepping there was — today's mult=1

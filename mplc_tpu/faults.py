@@ -1,0 +1,185 @@
+"""Deterministic fault injection for the coalition sweep engine.
+
+Long Shapley sweeps die to three families of failure on real fleets:
+transient XLA/runtime errors (tunnel hiccups, preempted programs), HBM
+exhaustion (RESOURCE_EXHAUSTED on a batch that autotuned too wide), and
+hard kills mid-run (OS OOM killer, preemption, power). Every recovery
+path in `contrib/engine.py` — retry/backoff, cap degradation, autosave
+resume — must be testable on CPU in the fast tier, so this module turns
+each failure family into an *injectable*, deterministic event.
+
+Plan grammar (`MPLC_TPU_FAULT_PLAN`): comma-separated entries
+
+    <kind>@<site><ordinal>
+
+      kind  ::= transient | oom | crash
+      site  ::= batch   (the dispatch boundary of the Nth device batch)
+              | harvest (the result-fetch boundary of the Nth batch)
+
+    e.g.  MPLC_TPU_FAULT_PLAN=transient@batch3,oom@batch5,crash@batch7
+
+Batches are numbered 1-based in engine dispatch order, counted once per
+batch (a RETRY of batch N keeps ordinal N — so `transient@batch3` fails
+batch 3's first attempt and lets the bit-identical retry through).
+Repeating an entry queues multiple faults at the same boundary
+(`transient@batch1,transient@batch1` fails the first attempt AND the
+first retry). Each entry fires exactly once.
+
+Injected exception classes mirror the real failures' types so the
+engine's classifier code paths are the ones exercised:
+
+  - `InjectedTransient` subclasses the runtime's `XlaRuntimeError` (when
+    available) with an `INTERNAL:` status prefix — retryable.
+  - `InjectedOom` ditto with a `RESOURCE_EXHAUSTED:` prefix — triggers
+    cap degradation, never retried as-is.
+  - `InjectedCrash` subclasses `BaseException` (like `KeyboardInterrupt`)
+    so no recovery path can swallow it — it simulates a process kill and
+    unwinds everything; resume happens from the autosave in a new engine.
+
+Malformed plan entries warn and are skipped: a typo in a fault plan must
+never itself crash a production run.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import warnings
+
+FAULT_PLAN_ENV = "MPLC_TPU_FAULT_PLAN"
+
+try:  # the concrete class jax raises for device/runtime failures
+    from jaxlib.xla_extension import XlaRuntimeError as _XlaRuntimeError
+except Exception:  # pragma: no cover - toolchain without the symbol
+    _XlaRuntimeError = RuntimeError
+
+
+class InjectedTransient(_XlaRuntimeError):
+    """A retryable runtime failure (same class as the real thing)."""
+
+
+class InjectedOom(_XlaRuntimeError):
+    """An injected RESOURCE_EXHAUSTED — drives the cap-degradation ladder."""
+
+
+class InjectedCrash(BaseException):
+    """A simulated hard kill. BaseException: retry/degradation code paths
+    catching `Exception` can never swallow it, mirroring a real SIGKILL's
+    absence of in-process recovery."""
+
+
+# Real XlaRuntimeError messages lead with a gRPC-style status code. Codes
+# that indicate a broken *program or request* are permanent: retrying the
+# identical dispatch can only fail identically. Everything else (INTERNAL,
+# UNAVAILABLE, DEADLINE_EXCEEDED, ABORTED, UNKNOWN, ...) is presumed
+# transient — the tunnel/fleet class of failure retries are for.
+_PERMANENT_STATUS = ("INVALID_ARGUMENT", "NOT_FOUND", "FAILED_PRECONDITION",
+                     "UNIMPLEMENTED", "PERMISSION_DENIED", "UNAUTHENTICATED")
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM when allocating")
+
+
+def is_oom(err: BaseException) -> bool:
+    """True for HBM/host exhaustion failures: the cap-degradation family,
+    never blind-retried (the identical batch would exhaust identically)."""
+    if isinstance(err, InjectedOom):
+        return True
+    if not isinstance(err, Exception):
+        return False
+    msg = str(err)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def is_transient(err: BaseException) -> bool:
+    """True for failures worth retrying bit-identically: injected
+    transients and real `XlaRuntimeError`s whose status code is not in the
+    permanent family. OOM is classified separately (`is_oom`); plain
+    Python exceptions (bugs) are never transient."""
+    if isinstance(err, InjectedTransient):
+        return True
+    if is_oom(err):
+        return False
+    if _XlaRuntimeError is RuntimeError:
+        # toolchain without the real class: every RuntimeError would
+        # match — refuse to blind-retry host-side bugs there
+        return False
+    if not isinstance(err, _XlaRuntimeError):
+        return False
+    msg = str(err)
+    return not any(msg.lstrip().startswith(code) for code in _PERMANENT_STATUS)
+
+
+_ENTRY_RE = re.compile(
+    r"^(transient|oom|crash)@(batch|harvest)([0-9]+)$")
+
+
+def parse_fault_plan(spec: str | None) -> dict:
+    """`{(site, ordinal): [kind, ...]}` from the plan grammar. Unknown or
+    malformed entries warn and are dropped; an empty/unset spec is an
+    empty plan (the production no-op)."""
+    plan: dict = {}
+    if not spec:
+        return plan
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        m = _ENTRY_RE.match(entry)
+        if m is None or int(m.group(3)) < 1:
+            warnings.warn(
+                f"{FAULT_PLAN_ENV}: ignoring malformed entry {entry!r} "
+                f"(expected <transient|oom|crash>@<batch|harvest><N>, N >= 1)",
+                stacklevel=2)
+            continue
+        kind, site, ordinal = m.group(1), m.group(2), int(m.group(3))
+        # 'batch' is the dispatch boundary in the engine's vocabulary
+        site = "dispatch" if site == "batch" else site
+        plan.setdefault((site, ordinal), []).append(kind)
+    return plan
+
+
+class FaultInjector:
+    """Consulted by the engine at every dispatch/harvest boundary.
+
+    `check(site, ordinal)` raises the next planned fault for that
+    boundary, at most once per plan entry; with an empty plan it is a
+    no-op attribute read. The engine numbers batches itself and passes
+    the ordinal in, so retries of a batch re-check the SAME ordinal and a
+    consumed entry lets the retry through — that property is what makes
+    `transient@batchK` mean "batch K fails once, then recovers"."""
+
+    __slots__ = ("plan", "injected")
+
+    def __init__(self, plan: dict | None = None):
+        self.plan = plan or {}
+        self.injected = 0
+
+    @classmethod
+    def from_env(cls) -> "FaultInjector":
+        return cls(parse_fault_plan(os.environ.get(FAULT_PLAN_ENV)))
+
+    @property
+    def armed(self) -> bool:
+        return bool(self.plan)
+
+    def check(self, site: str, ordinal: int) -> None:
+        if not self.plan:
+            return
+        kinds = self.plan.get((site, ordinal))
+        if not kinds:
+            return
+        kind = kinds.pop(0)
+        if not kinds:
+            del self.plan[(site, ordinal)]
+        self.injected += 1
+        from .obs import metrics as obs_metrics
+        from .obs import trace as obs_trace
+        obs_metrics.counter("engine.faults_injected").inc()
+        obs_trace.event("engine.fault", kind=kind, site=site, ordinal=ordinal)
+        where = f"({site} boundary, batch {ordinal})"
+        if kind == "transient":
+            raise InjectedTransient(f"INTERNAL: injected transient fault {where}")
+        if kind == "oom":
+            raise InjectedOom(
+                f"RESOURCE_EXHAUSTED: injected device OOM {where}")
+        raise InjectedCrash(f"injected crash {where}")

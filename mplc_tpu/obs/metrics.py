@@ -24,6 +24,17 @@ Metric names used by the instrumented paths:
                                                masked path runs P)
     engine.pad_waste_fraction         histogram per-batch padding fraction
     engine.device_mem_high_water_bytes gauge   peak bytes (memory_stats)
+    engine.retries                    counter  transient-failure batch
+                                               retries (dispatch + harvest)
+    engine.backoff_sec                counter  seconds slept in retry
+                                               backoff
+    engine.cap_halvings               counter  rungs taken down the OOM
+                                               cap-degradation ladder
+    engine.cpu_degraded_batches       counter  batches run on the ladder's
+                                               terminal per-batch CPU path
+    engine.cpu_degraded_coalitions    counter  coalitions trained there
+    engine.faults_injected            counter  faults fired by the
+                                               MPLC_TPU_FAULT_PLAN hook
 
 `snapshot()` exports the whole registry as a plain dict (JSON-ready);
 `reset()` clears it (tests and per-run report boundaries).

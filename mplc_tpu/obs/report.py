@@ -21,7 +21,12 @@ quantities every perf PR needs as a measured before/after:
     from the engine.batch events, and — when the caller supplies the
     model's forward FLOPs per sample (models/zoo.fwd_flops_per_sample or
     the XLA cost model) — a model-FLOPs rate over the evaluate wall-clock
-    plus an MFU proxy against a supplied peak-FLOPs figure.
+    plus an MFU proxy against a supplied peak-FLOPs figure;
+  - a resilience row: transient retries and backoff seconds
+    (engine.retry events), OOM cap halvings and the CPU-path flip
+    (engine.degrade), batches/coalitions that ran on the degraded CPU
+    rung, and injected-fault counts (engine.fault) — so every recorded
+    number says whether it was earned on a clean or a degraded run.
 
 The report is derived from SPANS of the collected region only, so callers
 get a clean per-run view without resetting the process-global metrics
@@ -57,6 +62,11 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     samples = partner_passes = 0
     estimators = []
     fits = []
+    retries = 0
+    backoff_s = 0.0
+    cap_halvings = cpu_fallbacks = 0
+    cpu_batches = cpu_coalitions = 0
+    faults_injected = 0
 
     for rec in records:
         name = rec.get("name")
@@ -94,6 +104,20 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             epochs += int(a.get("epochs", 0))
             samples += int(a.get("samples", 0))
             partner_passes += int(a.get("partner_passes", 0))
+            if a.get("degraded") == "cpu":
+                cpu_batches += 1
+                cpu_coalitions += int(a.get("coalitions", 0))
+        elif name == "engine.retry":
+            retries += 1
+            backoff_s += float(a.get("backoff_sec", 0.0))
+        elif name == "engine.degrade":
+            # every degrade event is one rung down the ladder; the last
+            # rung flips the engine onto the per-batch CPU path
+            cap_halvings += 1
+            if a.get("action") == "cpu_fallback":
+                cpu_fallbacks += 1
+        elif name == "engine.fault":
+            faults_injected += 1
         elif name == "contributivity":
             estimators.append({"method": a.get("method", "?"), "seconds": dur})
         elif name == "mpl.fit":
@@ -158,6 +182,15 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             "pad_waste_fraction": padding / slots_total if slots_total else None,
             "epochs_trained": epochs,
         },
+        "resilience": {
+            "retries": retries,
+            "backoff_s": backoff_s,
+            "cap_halvings": cap_halvings,
+            "cpu_degraded": cpu_fallbacks > 0,
+            "cpu_batches": cpu_batches,
+            "cpu_coalitions": cpu_coalitions,
+            "faults_injected": faults_injected,
+        },
         "per_width": per_width,
         "compiles": compiles,
         "estimators": estimators,
@@ -191,6 +224,18 @@ def format_report(report: dict) -> str:
         f"padding={b['padding']}  pad_waste="
         + (f"{pw:.1%}" if pw is not None else "n/a")
         + f"  epochs={b['epochs_trained']}")
+    r = report.get("resilience")
+    if r is not None:
+        # rendered even when all-zero: a clean run should SAY it was clean
+        line = (f"  resilience  retries={r['retries']}  "
+                f"backoff={r['backoff_s']:.2f}s  "
+                f"cap_halvings={r['cap_halvings']}  "
+                f"cpu_batches={r['cpu_batches']}")
+        if r.get("cpu_coalitions"):
+            line += f"  cpu_coalitions={r['cpu_coalitions']}"
+        if r.get("faults_injected"):
+            line += f"  faults_injected={r['faults_injected']}"
+        lines.append(line)
     c = report.get("compute") or {}
     if c.get("train_samples"):
         sps = c.get("samples_per_s")

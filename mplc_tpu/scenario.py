@@ -345,10 +345,7 @@ class Scenario:
             contrib = Contributivity(scenario=self)
             if self.contributivity_cache_from and \
                     not self._charac_engine.first_charac_fct_calls_count:
-                self._charac_engine.load_cache(self.contributivity_cache_from)
-                logger.info(f"Resumed coalition cache from "
-                            f"{self.contributivity_cache_from} "
-                            f"({len(self._charac_engine.charac_fct_values)} entries)")
+                self._resume_coalition_cache()
             if not self.is_dry_run:
                 # incremental checkpointing: every trained device batch is
                 # durable immediately, so a crash mid-sweep resumes cheaply
@@ -360,6 +357,33 @@ class Scenario:
         if self.methods and self._charac_engine is not None and not self.is_dry_run:
             self._charac_engine.save_cache(self.save_folder / "coalition_cache.json")
         return 0
+
+    def _resume_coalition_cache(self):
+        """Resume hardening: a corrupt or truncated autosave (power loss
+        during the final write of a killed run, interrupted copy) is
+        QUARANTINED — renamed to `<name>.corrupt`, warned about, and the
+        sweep starts cold — instead of crashing `run()` before any
+        compute. A fingerprint mismatch still raises: that cache is valid
+        but describes a different game, and silently recomputing would
+        mask a configuration error."""
+        from .contrib.engine import CacheIntegrityError
+
+        path = Path(self.contributivity_cache_from)
+        try:
+            self._charac_engine.load_cache(path)
+        except CacheIntegrityError as e:
+            quarantine = path.with_name(path.name + ".corrupt")
+            try:
+                path.replace(quarantine)
+                where = f"quarantined to {quarantine}"
+            except OSError as rename_err:
+                where = f"left in place (quarantine rename failed: {rename_err})"
+            logger.warning(
+                f"coalition cache {path} is unusable ({e}); {where}; "
+                f"starting the sweep cold")
+            return
+        logger.info(f"Resumed coalition cache from {path} "
+                    f"({len(self._charac_engine.charac_fct_values)} entries)")
 
     # ------------------------------------------------------------------
 

@@ -155,6 +155,18 @@ def load_telemetry_compute(path):
     return dict(rec.get("report", {}).get("compute", {}) or {})
 
 
+def load_telemetry_resilience(path):
+    """The resilience row from a bench telemetry sidecar: retries, OOM cap
+    halvings, CPU-degraded batches. A projection fed by a degraded run's
+    numbers is projecting the DEGRADED schedule — the printout flags it.
+    Pre-resilience sidecars (older report schema) return {} rather than
+    failing."""
+    import json
+    with open(path) as f:
+        rec = json.load(f)
+    return dict(rec.get("report", {}).get("resilience", {}) or {})
+
+
 def parse_batch_times(log_path):
     """Per-slot-size batch durations (s), from either input kind:
 
@@ -354,6 +366,14 @@ def main():
                      else " mfu_proxy=n/a")
                   + " — the per-step intensity the width-scaling model "
                     "assumes; projection band unchanged by this row")
+        r = load_telemetry_resilience(args.telemetry)
+        if r.get("retries") or r.get("cap_halvings") or r.get("cpu_batches"):
+            print(f"measured resilience: retries={r.get('retries', 0)} "
+                  f"cap_halvings={r.get('cap_halvings', 0)} "
+                  f"cpu_batches={r.get('cpu_batches', 0)} — DEGRADED run: "
+                  "its batch times mix recovery overhead (and possibly the "
+                  "CPU rung) into the device schedule; prefer a clean "
+                  "sidecar for projection")
         print()
 
     times = parse_batch_times(args.log)

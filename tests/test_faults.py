@@ -1,0 +1,437 @@
+"""Fault-tolerant sweep execution (mplc_tpu/faults.py + the engine's
+recovery ladder): plan-grammar parsing, error classification, transient
+retry/backoff, OOM cap degradation down to the per-batch CPU path,
+crash/resume equivalence, and coalition-cache integrity.
+
+The governing invariant, asserted throughout: a recovered sweep's v(S)
+table is BIT-IDENTICAL to a fault-free run's — retries re-dispatch the
+same per-coalition rng-fold streams, re-bucketing only moves batch
+boundaries (row-independent vmapped training), and resume replays the
+memo cache."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mplc_tpu import faults
+from mplc_tpu.contrib.engine import CacheIntegrityError, CharacteristicEngine
+from mplc_tpu.contrib.shapley import powerset_order
+from mplc_tpu.obs import metrics, report, trace
+
+
+def scenario():
+    from helpers import build_scenario
+    return build_scenario(partners_count=5,
+                          amounts_per_partner=[0.1, 0.15, 0.2, 0.25, 0.3],
+                          dataset_name="titanic", epoch_count=2,
+                          gradient_updates_per_pass_count=2, seed=9)
+
+
+SUBSETS = powerset_order(5)
+
+# cap=1 on the 8-device mesh: singles = batch 1 (width 8); merge-mode
+# multis = width-3 bucket (sizes 2+3, 20 coalitions -> batches 2-4) then
+# the width-5 bucket (sizes 4+5, 6 coalitions -> batch 5)
+_FAULT_KNOBS = ("MPLC_TPU_FAULT_PLAN", "MPLC_TPU_MAX_RETRIES",
+                "MPLC_TPU_MAX_CAP_HALVINGS", "MPLC_TPU_PIPELINE_BATCHES")
+
+
+@pytest.fixture(autouse=True)
+def _fault_env(monkeypatch):
+    for k in _FAULT_KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+_REF = {}
+
+
+def reference():
+    """Fault-free v(S) for `scenario()` under cap=1, computed once per
+    pytest process (the autouse fixture guarantees a clean fault env at
+    every call site)."""
+    assert "MPLC_TPU_FAULT_PLAN" not in os.environ
+    if "vals" not in _REF:
+        _REF["vals"] = CharacteristicEngine(scenario()).evaluate(SUBSETS)
+    return _REF["vals"]
+
+
+# -- plan grammar ------------------------------------------------------------
+
+def test_plan_grammar_parses_sites_and_repeats():
+    plan = faults.parse_fault_plan(
+        "transient@batch3, oom@batch5,crash@batch7,transient@harvest2,"
+        "transient@batch3")
+    assert plan == {("dispatch", 3): ["transient", "transient"],
+                    ("dispatch", 5): ["oom"],
+                    ("dispatch", 7): ["crash"],
+                    ("harvest", 2): ["transient"]}
+    assert faults.parse_fault_plan(None) == {}
+    assert faults.parse_fault_plan("") == {}
+
+
+def test_plan_malformed_entries_warn_and_are_skipped():
+    with pytest.warns(UserWarning, match="malformed entry"):
+        plan = faults.parse_fault_plan("bogus@batch3,transient@batch2")
+    assert plan == {("dispatch", 2): ["transient"]}
+    for bad in ("transient@epoch3", "transient@batch0", "oom@batch-1",
+                "transient", "@batch3", "oom@batchx"):
+        with pytest.warns(UserWarning, match="malformed entry"):
+            assert faults.parse_fault_plan(bad) == {}
+
+
+def test_injector_fires_each_entry_exactly_once():
+    inj = faults.FaultInjector(faults.parse_fault_plan("transient@batch2"))
+    inj.check("dispatch", 1)            # wrong ordinal: no-op
+    inj.check("harvest", 2)             # wrong site: no-op
+    with pytest.raises(faults.InjectedTransient):
+        inj.check("dispatch", 2)
+    inj.check("dispatch", 2)            # consumed: the retry goes through
+    assert inj.injected == 1 and not inj.armed
+
+
+# -- error classification ----------------------------------------------------
+
+def test_error_classifier():
+    from jaxlib.xla_extension import XlaRuntimeError
+
+    assert faults.is_transient(faults.InjectedTransient("INTERNAL: x"))
+    assert faults.is_transient(XlaRuntimeError("INTERNAL: device halted"))
+    assert faults.is_transient(XlaRuntimeError("UNAVAILABLE: tunnel reset"))
+    # a broken program/request fails identically on retry: permanent
+    assert not faults.is_transient(
+        XlaRuntimeError("INVALID_ARGUMENT: bad shape"))
+    # host-side bugs are never transient
+    assert not faults.is_transient(RuntimeError("INTERNAL: looks xla-ish"))
+    assert not faults.is_transient(ValueError("nope"))
+    # OOM is its own family, never blind-retried
+    oom = XlaRuntimeError("RESOURCE_EXHAUSTED: 13.5G of 16G HBM")
+    assert faults.is_oom(oom) and not faults.is_transient(oom)
+    assert faults.is_oom(faults.InjectedOom("RESOURCE_EXHAUSTED: injected"))
+    assert not faults.is_oom(faults.InjectedTransient("INTERNAL: x"))
+    # the crash class is a BaseException: recovery code catching
+    # Exception can never swallow it
+    assert not isinstance(faults.InjectedCrash("kill"), Exception)
+
+
+# -- transient retry ---------------------------------------------------------
+
+def test_transient_dispatch_fault_retries_bit_identically(monkeypatch):
+    ref = reference()
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "transient@batch2")
+    eng = CharacteristicEngine(scenario())
+    with trace.collect() as recs:
+        vals = eng.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    snap = metrics.snapshot()["counters"]
+    assert snap["engine.retries"] == 1
+    assert snap["engine.faults_injected"] == 1
+    assert not eng._faults.armed
+    rep = report.sweep_report(recs)
+    assert rep["resilience"]["retries"] == 1
+    assert rep["resilience"]["faults_injected"] == 1
+    assert rep["resilience"]["cap_halvings"] == 0
+    assert "resilience" in report.format_report(rep)
+
+
+def test_transient_harvest_fault_redispatches_bit_identically(monkeypatch):
+    ref = reference()
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "transient@harvest2")
+    vals = CharacteristicEngine(scenario()).evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    assert metrics.snapshot()["counters"]["engine.retries"] == 1
+
+
+def test_retry_budget_exhaustion_propagates(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_MAX_RETRIES", "2")
+    # 3 attempts (initial + 2 retries) all fail -> the 3rd error propagates
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN",
+                       "transient@batch1,transient@batch1,transient@batch1")
+    eng = CharacteristicEngine(scenario())
+    with pytest.raises(faults.InjectedTransient):
+        eng.evaluate(SUBSETS)
+    assert metrics.snapshot()["counters"]["engine.retries"] == 2
+
+
+def test_backoff_is_exponential_and_bounded(monkeypatch):
+    from mplc_tpu import constants
+
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0.0")
+    sleeps = []
+    monkeypatch.setattr("time.sleep", sleeps.append)
+    eng = CharacteristicEngine(scenario())
+    eng._retry_backoff = 8.0  # pretend-large base; sleep is patched out
+    eng._max_retries = 5
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 4:
+            raise faults.InjectedTransient("INTERNAL: flaky")
+        return "ok"
+
+    assert eng._retry_transient(flaky, "dispatch") == "ok"
+    assert sleeps == [8.0, 16.0, 30.0, 30.0]  # doubling, capped at 30 s
+    assert constants.RETRY_BACKOFF_CAP_SEC == 30.0
+    assert metrics.snapshot()["counters"]["engine.backoff_sec"] == sum(sleeps)
+
+
+# -- OOM degradation ladder --------------------------------------------------
+
+def test_oom_halves_cap_and_rebuckets_bit_identically(monkeypatch):
+    ref = reference()
+    # cap=2 -> width-16 multi batches; batch 2 (the first wide one)
+    # completes, then the injected OOM on batch 3 halves to cap=1 -> the
+    # remaining subsets re-bucket to width-8 batches
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "2")
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "oom@batch3")
+    eng = CharacteristicEngine(scenario())
+    with trace.collect() as recs:
+        vals = eng.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    assert eng._cap_halvings == 1 and not eng._cpu_degraded
+    snap = metrics.snapshot()["counters"]
+    assert snap["engine.cap_halvings"] == 1
+    degrades = [r for r in recs if r["name"] == "engine.degrade"]
+    assert [d["attrs"]["action"] for d in degrades] == ["halve_cap"]
+    # every batch dispatched after the degrade ran at the halved width
+    batch_widths = [r["attrs"]["width"] for r in recs
+                    if r["name"] == "engine.batch"]
+    assert 16 in batch_widths        # the pre-OOM width really was wider
+    assert batch_widths[-1] == 8
+    rep = report.sweep_report(recs)
+    assert rep["resilience"]["cap_halvings"] == 1
+    assert rep["resilience"]["cpu_batches"] == 0
+    # each coalition was still trained exactly once
+    assert eng.first_charac_fct_calls_count == len(SUBSETS)
+
+
+def test_oom_at_harvest_recovers_bit_identically(monkeypatch):
+    ref = reference()
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "oom@harvest2")
+    eng = CharacteristicEngine(scenario())
+    vals = eng.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    assert eng._cap_halvings == 1
+    assert eng.first_charac_fct_calls_count == len(SUBSETS)
+
+
+def test_oom_on_pending_harvest_during_dispatch_oom_recovers(monkeypatch):
+    """With async dispatch an OOM often surfaces at the in-flight batch's
+    FETCH while the next batch's dispatch is also OOMing: both boundaries
+    must ride the ladder (the pending drain inside the dispatch-OOM
+    handler goes through the recover path, not a bare harvest)."""
+    ref = reference()
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "oom@harvest2,oom@batch3")
+    eng = CharacteristicEngine(scenario())
+    vals = eng.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    assert eng._cap_halvings == 2
+    assert eng.first_charac_fct_calls_count == len(SUBSETS)
+
+
+def test_fetch_retry_covers_redispatch_failures(monkeypatch):
+    """A transient failure raised by the RE-dispatch itself (the
+    correlated-outage case) must consume a retry, not escape the
+    ladder."""
+    eng = CharacteristicEngine(scenario())
+    calls = {"redispatch": 0}
+
+    def redispatch():
+        calls["redispatch"] += 1
+        if calls["redispatch"] == 1:
+            raise faults.InjectedTransient("INTERNAL: redispatch flake")
+        return lambda: "ok"
+
+    def failing_fetch():
+        raise faults.InjectedTransient("INTERNAL: fetch flake")
+
+    meta = {"redispatch": redispatch, "ordinal": 0}
+    assert eng._fetch_with_retry(failing_fetch, meta) == "ok"
+    # 2 of the 3 retries consumed: the failed fetch, the failed re-dispatch
+    assert metrics.snapshot()["counters"]["engine.retries"] == 2
+
+
+def test_singles_sliced_oom_recovers_bit_identically(monkeypatch):
+    """The 2-D mode's data-sliced singles path has its own OOM rung
+    (recursion over the still-missing singles at the halved cap)."""
+    singles = [(i,) for i in range(4)]
+
+    def scenario_2d():
+        from helpers import build_scenario
+        return build_scenario(partners_count=4,
+                              amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
+                              dataset_name="titanic", epoch_count=2,
+                              gradient_updates_per_pass_count=2, seed=9)
+
+    monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "2")
+    ref_eng = CharacteristicEngine(scenario_2d())
+    assert ref_eng._pipe2d is not None
+    ref = ref_eng.evaluate(singles)
+
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "oom@batch1")
+    eng = CharacteristicEngine(scenario_2d())
+    vals = eng.evaluate(singles)
+    np.testing.assert_array_equal(vals, ref)
+    assert eng._cap_halvings == 1 and not eng._cpu_degraded
+    assert eng.first_charac_fct_calls_count == len(singles)
+    # and a fetch-side OOM recovers too
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "oom@harvest1")
+    eng2 = CharacteristicEngine(scenario_2d())
+    np.testing.assert_array_equal(eng2.evaluate(singles), ref)
+    assert eng2._cap_halvings == 1
+
+
+def test_oom_ladder_ends_in_cpu_path_bit_identically(monkeypatch):
+    ref = reference()
+    monkeypatch.setenv("MPLC_TPU_MAX_CAP_HALVINGS", "1")
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "oom@batch2,oom@batch3")
+    eng = CharacteristicEngine(scenario())
+    with trace.collect() as recs:
+        vals = eng.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    assert eng._cpu_degraded
+    snap = metrics.snapshot()["counters"]
+    assert snap["engine.cpu_degraded_batches"] > 0
+    assert snap["engine.cpu_degraded_coalitions"] > 0
+    cpu_batches = [r for r in recs if r["name"] == "engine.batch"
+                   and r["attrs"].get("degraded") == "cpu"]
+    assert cpu_batches
+    rep = report.sweep_report(recs)
+    assert rep["resilience"]["cpu_degraded"] is True
+    assert rep["resilience"]["cpu_batches"] == len(cpu_batches)
+    assert rep["resilience"]["cpu_coalitions"] == sum(
+        r["attrs"]["coalitions"] for r in cpu_batches)
+    text = report.format_report(rep)
+    assert "cpu_batches=" in text and "cap_halvings=2" in text
+    assert eng.first_charac_fct_calls_count == len(SUBSETS)
+
+
+# -- crash / resume ----------------------------------------------------------
+
+def test_crash_resume_from_autosave_is_bit_identical(tmp_path, monkeypatch):
+    """The autosave claim, end-to-end: kill a pipelined sweep (two batches
+    in flight) mid-run via the crash fault, resume a FRESH engine from the
+    autosave, and the final Shapley-sweep v(S) table is bit-identical to
+    an uninterrupted run — with only the missing coalitions retrained."""
+    ref = reference()
+    path = tmp_path / "coalition_cache.json"
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "crash@batch4")
+    eng = CharacteristicEngine(scenario())
+    assert eng._pipeline_batches  # overlap on: the harder crash bound
+    eng.autosave_path = path
+    with pytest.raises(faults.InjectedCrash):
+        eng.evaluate(SUBSETS)
+    monkeypatch.delenv("MPLC_TPU_FAULT_PLAN")
+
+    resumed = CharacteristicEngine(scenario())
+    resumed.load_cache(path)
+    done = resumed.first_charac_fct_calls_count
+    assert 0 < done < len(SUBSETS)  # a partial run, genuinely resumed
+    vals = resumed.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    # only the missing coalitions were retrained
+    assert resumed.first_charac_fct_calls_count == len(SUBSETS)
+
+
+def test_crash_is_not_swallowed_by_retry_or_degradation(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "crash@batch1")
+    eng = CharacteristicEngine(scenario())
+    with pytest.raises(faults.InjectedCrash):
+        eng.evaluate(SUBSETS)
+    assert metrics.snapshot()["counters"].get("engine.retries") is None
+
+
+# -- cache integrity ---------------------------------------------------------
+
+def _saved_cache(tmp_path):
+    from test_contrib import additive, fake_scenario
+
+    sc = fake_scenario(3, additive([0.1, 0.25, 0.65]))
+    eng = sc._charac_engine
+    eng.evaluate(powerset_order(3))
+    path = tmp_path / "cache.json"
+    eng.save_cache(path)
+    return eng, path
+
+
+def test_save_cache_embeds_verifiable_checksum(tmp_path):
+    import hashlib
+
+    from test_contrib import additive, fake_scenario
+
+    eng, path = _saved_cache(tmp_path)
+    rec = json.loads(path.read_text())
+    body = dict(rec)
+    digest = body.pop("payload_sha256")
+    assert digest == hashlib.sha256(json.dumps(body).encode()).hexdigest()
+    fresh = fake_scenario(3, additive([0.1, 0.25, 0.65]))._charac_engine
+    fresh.load_cache(path)
+    assert fresh.charac_fct_values == eng.charac_fct_values
+
+
+def test_truncated_cache_raises_integrity_error(tmp_path):
+    from test_contrib import additive, fake_scenario
+
+    _, path = _saved_cache(tmp_path)
+    text = path.read_text()
+    path.write_text(text[:len(text) // 2])
+    fresh = fake_scenario(3, additive([0.1, 0.25, 0.65]))._charac_engine
+    with pytest.raises(CacheIntegrityError, match="corrupt or truncated"):
+        fresh.load_cache(path)
+
+
+def test_bitflipped_cache_fails_checksum_never_poisons_vs(tmp_path):
+    """Valid JSON with corrupted VALUES (the silent-poison case a
+    truncation check can't catch) must fail the checksum, not load."""
+    from test_contrib import additive, fake_scenario
+
+    _, path = _saved_cache(tmp_path)
+    rec = json.loads(path.read_text())
+    rec["charac_fct_values"][1][1] += 0.25   # the poisoned v(S)
+    path.write_text(json.dumps(rec))
+    fresh = fake_scenario(3, additive([0.1, 0.25, 0.65]))._charac_engine
+    with pytest.raises(CacheIntegrityError, match="checksum"):
+        fresh.load_cache(path)
+
+
+def test_legacy_cache_without_checksum_still_loads(tmp_path):
+    from test_contrib import additive, fake_scenario
+
+    eng, path = _saved_cache(tmp_path)
+    rec = json.loads(path.read_text())
+    rec.pop("payload_sha256")
+    path.write_text(json.dumps(rec))
+    fresh = fake_scenario(3, additive([0.1, 0.25, 0.65]))._charac_engine
+    fresh.load_cache(path)
+    assert fresh.charac_fct_values == eng.charac_fct_values
+    # but a legacy-shaped file missing payload keys is still integrity-bad
+    path.write_text(json.dumps({"fingerprint": rec["fingerprint"]}))
+    with pytest.raises(CacheIntegrityError, match="missing keys"):
+        fresh.load_cache(path)
+
+
+def test_save_cache_fsyncs_before_replace(tmp_path, monkeypatch):
+    """The durability fix: the temp file must be fsync'd BEFORE os.replace
+    promotes it, or a power loss can promote an empty/partial file over a
+    good cache despite the atomic-rename claim."""
+    from test_contrib import additive, fake_scenario
+
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (events.append("fsync"), real_fsync(fd)))
+    monkeypatch.setattr(
+        os, "replace",
+        lambda a, b: (events.append("replace"), real_replace(a, b)))
+    _, path = _saved_cache(tmp_path)
+    assert "fsync" in events and "replace" in events
+    assert events.index("fsync") < events.index("replace")
+    # and the written file round-trips
+    fake_scenario(3, additive([0.1, 0.25, 0.65]))._charac_engine.load_cache(path)

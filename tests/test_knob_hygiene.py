@@ -1,0 +1,131 @@
+"""Knob-hygiene static check: every `MPLC_TPU_*` env knob the framework
+reads must be registered in `constants.ENV_KNOBS`, and every registered
+knob's class obligations must hold in bench.py — workload-shaping knobs
+appear in BOTH the cached-replay refusal list and the CPU-fallback
+env-strip list, sidecar knobs at least in the strip list.
+
+PRs 1-3 each extended bench's two lists by hand; this test makes
+forgetting one (or introducing an unregistered knob) a fast-tier failure
+instead of a silently wrong cached-replay / fallback number."""
+
+import importlib
+import inspect
+import re
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+bench = importlib.import_module("bench")
+
+from mplc_tpu import constants
+
+REPO = Path(__file__).resolve().parents[1]
+_KNOB_RE = re.compile(r"MPLC_TPU_[A-Z0-9_]+")
+
+
+def _knobs_in_sources() -> set:
+    found = set()
+    files = [REPO / "bench.py", REPO / "main.py", REPO / "__graft_entry__.py"]
+    files += sorted((REPO / "mplc_tpu").rglob("*.py"))
+    files += sorted((REPO / "scripts").glob("*.py"))
+    for f in files:
+        found |= set(_KNOB_RE.findall(f.read_text()))
+    return found
+
+
+def test_every_knob_in_source_is_registered():
+    """A new `MPLC_TPU_*` env var anywhere in the package/bench/scripts
+    source must be added to constants.ENV_KNOBS with a class — that is
+    what forces the bench-list decision to be made consciously."""
+    unregistered = _knobs_in_sources() - set(constants.ENV_KNOBS)
+    assert not unregistered, (
+        f"env knobs {sorted(unregistered)} are read in the source tree but "
+        "not registered in constants.ENV_KNOBS — register them (class "
+        "'workload' | 'sidecar' | 'ambient') so the bench replay/fallback "
+        "obligations are checked")
+
+
+def test_registry_has_no_stale_entries():
+    stale = set(constants.ENV_KNOBS) - _knobs_in_sources()
+    assert not stale, (
+        f"constants.ENV_KNOBS registers {sorted(stale)} but nothing in the "
+        "source tree reads them — remove the dead entries")
+
+
+def test_registry_classes_are_valid():
+    assert set(constants.ENV_KNOBS.values()) <= {"workload", "sidecar",
+                                                 "ambient"}
+
+
+def test_workload_knobs_refuse_replay_and_strip_from_fallback():
+    """Every workload-shaping knob must appear in bench's cached-replay
+    refusal source AND the CPU-fallback env-strip source: a cached TPU
+    number is a different workload under any non-default value, and the
+    reduced CPU child must not inherit parent tuning."""
+    src_replay = inspect.getsource(bench._replay_cached_tpu_result)
+    src_spawn = inspect.getsource(bench._spawn_cpu_fallback)
+    for knob, klass in sorted(constants.ENV_KNOBS.items()):
+        if klass != "workload":
+            continue
+        assert knob in src_replay, (
+            f"workload knob {knob} missing from "
+            "bench._replay_cached_tpu_result's refusal logic")
+        assert knob in src_spawn, (
+            f"workload knob {knob} missing from "
+            "bench._spawn_cpu_fallback's env-strip list")
+
+
+def test_sidecar_knobs_are_stripped_from_fallback():
+    """Sidecar/observability knobs must not leak into the CPU-fallback
+    child (it writes its own sidecars); they do not refuse replay."""
+    src_spawn = inspect.getsource(bench._spawn_cpu_fallback)
+    for knob, klass in sorted(constants.ENV_KNOBS.items()):
+        if klass == "sidecar":
+            assert knob in src_spawn, (
+                f"sidecar knob {knob} missing from "
+                "bench._spawn_cpu_fallback's env-strip list")
+
+
+def test_synth_noise_refusal_is_non_default_only(tmp_path, monkeypatch):
+    """MPLC_TPU_SYNTH_NOISE is always set by bench.main() before the
+    replay gate runs, so the gate must allow the bench's own 0.75 default
+    and refuse any other value (a different noise level is different
+    synthetic data — a different workload)."""
+    from test_bench_helpers import _clean_replay_env, _write_record
+
+    _clean_replay_env(monkeypatch)
+    monkeypatch.delenv("MPLC_TPU_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("MPLC_TPU_RETRY_BACKOFF_SEC", raising=False)
+    _write_record(tmp_path, "r5",
+                  "exact_shapley_mnist_10partners_8epochs_wallclock")
+    monkeypatch.setenv("MPLC_TPU_SYNTH_NOISE", "0.75")
+    assert bench._replay_cached_tpu_result(str(tmp_path)) is True
+    monkeypatch.setenv("MPLC_TPU_SYNTH_NOISE", "0.5")
+    assert bench._replay_cached_tpu_result(str(tmp_path)) is False
+
+
+def test_fault_knobs_refuse_replay(tmp_path, monkeypatch, capsys):
+    """Any set fault-tolerance knob refuses cached replay — a clean
+    cached number must not stand in for a run that was asked to inject
+    faults or reshape its recovery schedule (even re-stating a default
+    refuses, same strictness as the other workload knobs)."""
+    from test_bench_helpers import _clean_replay_env, _write_record
+
+    _clean_replay_env(monkeypatch)
+    monkeypatch.delenv("MPLC_TPU_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("MPLC_TPU_RETRY_BACKOFF_SEC", raising=False)
+    _write_record(tmp_path, "r5",
+                  "exact_shapley_mnist_10partners_8epochs_wallclock")
+    capsys.readouterr()
+    assert bench._replay_cached_tpu_result(str(tmp_path)) is True
+    capsys.readouterr()
+    for knob, val in (("MPLC_TPU_FAULT_PLAN", "transient@batch3"),
+                      ("MPLC_TPU_MAX_RETRIES", "3"),
+                      ("MPLC_TPU_RETRY_BACKOFF_SEC", "0.5"),
+                      ("MPLC_TPU_MAX_CAP_HALVINGS", "3")):
+        monkeypatch.setenv(knob, val)
+        assert bench._replay_cached_tpu_result(str(tmp_path)) is False, knob
+        monkeypatch.delenv(knob)
+    assert capsys.readouterr().out.strip() == ""

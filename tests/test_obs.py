@@ -218,14 +218,24 @@ def test_report_format_and_write(tmp_path):
     assert rep["wallclock"]["prep_s"] == 0.25
     assert rep["batches"]["pad_waste_fraction"] == 0.25
     assert rep["per_width"][0]["coalitions_per_s"] == 4.0
+    # a clean run says so explicitly: an all-zero resilience row
+    assert rep["resilience"] == {
+        "retries": 0, "backoff_s": 0.0, "cap_halvings": 0,
+        "cpu_degraded": False, "cpu_batches": 0, "cpu_coalitions": 0,
+        "faults_injected": 0}
     text = report.format_report(rep)
     assert "hit_rate=75.0%" in text
     assert "pad_waste=25.0%" in text
     assert "prep=0.25s" in text
-    # a report from an older run (no prep row recorded) still formats
+    assert "resilience  retries=0" in text
+    # a report from an older run (no prep/resilience rows recorded)
+    # still formats
     old = dict(rep, wallclock={k: v for k, v in rep["wallclock"].items()
                                if k != "prep_s"})
-    assert "prep=0.00s" in report.format_report(old)
+    old.pop("resilience")
+    old_text = report.format_report(old)
+    assert "prep=0.00s" in old_text
+    assert "resilience" not in old_text
     path = tmp_path / "rep.json"
     report.write_report(str(path), rep)
     assert json.loads(path.read_text())["memo"]["hits"] == 3

@@ -160,6 +160,62 @@ def test_grid_expansion_dataset_dict_init_model():
     assert params[0]["init_model_from"] == "random_initialization"
 
 
+# -- resume hardening --------------------------------------------------------
+
+def _titanic_resume_kwargs(cache_path):
+    return dict(partners_count=3, amounts_per_partner=[0.3, 0.3, 0.4],
+                dataset_name="titanic", epoch_count=2, minibatch_count=2,
+                gradient_updates_per_pass_count=2, is_early_stopping=False,
+                methods=["Independent scores"],
+                experiment_path="/tmp/mplc_tpu_tests", is_dry_run=True,
+                seed=7, contributivity_cache_from=str(cache_path))
+
+
+def test_run_quarantines_truncated_resume_cache(tmp_path, caplog):
+    """Malformed JSON in contributivity_cache_from must not crash run()
+    before any compute: the file is quarantined to *.corrupt, a warning
+    names it, and the sweep starts cold."""
+    import logging
+
+    cache = tmp_path / "coalition_cache.json"
+    cache.write_text('{"fingerprint": {"partners_count": 3}, "charac')
+    sc = Scenario(**_titanic_resume_kwargs(cache))
+    with caplog.at_level(logging.WARNING, logger="mplc_tpu"):
+        assert sc.run() == 0
+    assert not cache.exists()
+    quarantined = tmp_path / "coalition_cache.json.corrupt"
+    assert quarantined.exists()
+    assert "quarantined" in caplog.text and "starting the sweep cold" in caplog.text
+    # the sweep really ran cold: the singles were trained, not resumed
+    assert sc._charac_engine.first_charac_fct_calls_count == 3
+    scores = sc.contributivity_list[0].contributivity_scores
+    assert np.isfinite(scores).all()
+
+
+def test_run_still_raises_on_fingerprint_mismatch(tmp_path):
+    """Quarantine covers INTEGRITY failures only: a valid cache built for
+    a different scenario shape must still raise out of run() — silently
+    recomputing would mask a configuration error."""
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from helpers import build_scenario
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+
+    other = build_scenario(partners_count=4,
+                           amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
+                           dataset_name="titanic", epoch_count=2,
+                           gradient_updates_per_pass_count=2, seed=9)
+    eng = CharacteristicEngine(other)
+    eng.evaluate([(0,)])
+    cache = tmp_path / "coalition_cache.json"
+    eng.save_cache(cache)
+
+    sc = Scenario(**_titanic_resume_kwargs(cache))
+    with pytest.raises(ValueError, match="partners"):
+        sc.run()
+    assert cache.exists()  # a mismatched cache is NOT quarantined
+
+
 def test_split_then_corruption_pipeline(tiny_image_dataset):
     sc = Scenario(**_tiny_kwargs(tiny_image_dataset),
                   corrupted_datasets=["not_corrupted", "permuted", ["shuffled", 0.5]])
