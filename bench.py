@@ -53,6 +53,12 @@ retry, MPLC_TPU_MAX_CAP_HALVINGS for the OOM degradation ladder,
 MPLC_TPU_FAULT_PLAN to inject deterministic faults. The telemetry sidecar
 records a top-level "degraded" flag plus the report's resilience row, so
 a number earned on a degraded run is never mistaken for a clean one.
+Partner-level faults & trust: MPLC_TPU_PARTNER_FAULT_PLAN injects
+dropout/straggler/noisy/glabel partner misbehavior (changes the GAME, so
+it refuses cached replay); MPLC_TPU_SEED_ENSEMBLE=K batches K seed
+replicas of every coalition through the same buckets and adds a `trust`
+row (per-partner Shapley CIs + Kendall-tau rank stability) to the report
+and sidecar.
 """
 
 import json
@@ -170,6 +176,25 @@ _REPLAY_SHAPES = {
     "5": "tmcs_cifar10_8partners_8epochs",
 }
 
+# Workload-shaping knobs shared by the cached-replay refusal AND the
+# CPU-fallback env-strip: any set value makes a cached full-scale TPU
+# number a DIFFERENT workload, and must not leak into the reduced CPU
+# child. ONE list, referenced from both sites — PRs 1-6 each extended two
+# hand-maintained copies in lockstep, which is exactly how a knob ends up
+# in one list and not the other. (MPLC_TPU_SYNTH_NOISE is special-cased
+# at each site: main() always sets it, so only a NON-default value
+# refuses replay, and the fallback child re-sets its own.)
+_WORKLOAD_KNOBS = (
+    "BENCH_DTYPE", "MPLC_TPU_BATCH_CAP_CEILING",
+    "MPLC_TPU_COALITIONS_PER_DEVICE",
+    "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_FAULT_PLAN",
+    "MPLC_TPU_MAX_CAP_HALVINGS", "MPLC_TPU_MAX_RETRIES",
+    "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_FAULT_PLAN",
+    "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
+    "MPLC_TPU_RETRY_BACKOFF_SEC", "MPLC_TPU_SEED_ENSEMBLE",
+    "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
+    "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SYNTH_SCALE")
+
 
 def _replay_cached_tpu_result(repo_root: str | None = None) -> bool:
     """Tunnel down and this is a driver-shaped run (default workload for
@@ -204,16 +229,9 @@ def _replay_cached_tpu_result(repo_root: str | None = None) -> bool:
     # value refuses, so the pipelining opt-out "0" and merge opt-out "0"
     # also block replay of the default-workload number; the fault-tolerance
     # knobs reshape the run's schedule — injected faults, retry sleeps, cap
-    # degradation — so a clean cached number must not stand in for them)
-    for knob in ("BENCH_DTYPE", "MPLC_TPU_BATCH_CAP_CEILING",
-                 "MPLC_TPU_COALITIONS_PER_DEVICE",
-                 "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_FAULT_PLAN",
-                 "MPLC_TPU_MAX_CAP_HALVINGS", "MPLC_TPU_MAX_RETRIES",
-                 "MPLC_TPU_NO_SLOTS",
-                 "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
-                 "MPLC_TPU_RETRY_BACKOFF_SEC",
-                 "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
-                 "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SYNTH_SCALE"):
+    # degradation — so a clean cached number must not stand in for them;
+    # the partner-fault plan and seed ensemble reshape the GAME itself)
+    for knob in _WORKLOAD_KNOBS:
         if os.environ.get(knob):
             return False
     # MPLC_TPU_SYNTH_NOISE is always set by the time this runs (main()
@@ -285,25 +303,17 @@ def _spawn_cpu_fallback() -> int:
     # child, or fallback numbers vary with whatever TPU tuning was set —
     # and a tight accelerator stall/init timeout would re-arm the child's
     # watchdog, which is deliberately off on CPU.
-    for knob in ("BENCH_DTYPE", "MPLC_TPU_BATCH_CAP_CEILING",
-                 "MPLC_TPU_COALITIONS_PER_DEVICE",
-                 "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_FAULT_PLAN",
-                 "MPLC_TPU_MAX_CAP_HALVINGS", "MPLC_TPU_MAX_RETRIES",
-                 "MPLC_TPU_NO_SLOTS",
-                 "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
-                 "MPLC_TPU_RETRY_BACKOFF_SEC",
-                 "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
-                 "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SYNTH_SCALE",
-                 # the child's main() re-sets the canonical 0.75 — an
-                 # inherited custom noise would reshape the fallback number
-                 "MPLC_TPU_SYNTH_NOISE",
-                 "BENCH_STALL_TIMEOUT", "BENCH_INIT_TIMEOUT",
-                 # the child writes its own _cpu_fallback-suffixed sidecar;
-                 # inheriting an explicit path would race the parent's file
-                 # (and a device-profile dir makes no sense for the CPU
-                 # child either)
-                 "BENCH_TELEMETRY_FILE", "MPLC_TPU_TRACE_FILE",
-                 "MPLC_TPU_PROFILE_DIR"):
+    for knob in _WORKLOAD_KNOBS + (
+            # the child's main() re-sets the canonical 0.75 — an
+            # inherited custom noise would reshape the fallback number
+            "MPLC_TPU_SYNTH_NOISE",
+            "BENCH_STALL_TIMEOUT", "BENCH_INIT_TIMEOUT",
+            # the child writes its own _cpu_fallback-suffixed sidecar;
+            # inheriting an explicit path would race the parent's file
+            # (and a device-profile dir makes no sense for the CPU
+            # child either)
+            "BENCH_TELEMETRY_FILE", "MPLC_TPU_TRACE_FILE",
+            "MPLC_TPU_PROFILE_DIR"):
         env.pop(knob, None)
     env.update(
         # A clean PYTHONPATH drops the ambient accelerator registration,
@@ -620,6 +630,16 @@ def bench_exact_shapley(epochs, dtype):
     from mplc_tpu.utils import profile_trace
     with profile_trace(), obs_trace.collect() as tele:
         accs = timed.evaluate(coalitions)
+        if timed.seed_ensemble > 1:
+            # trust calibration rides the SAME sweep (replicas were extra
+            # batch rows): emit the trust row inside the collected region
+            # so the report + telemetry sidecar carry it
+            from mplc_tpu.contrib.shapley import trust_summary
+            trust = trust_summary(n_partners, timed.charac_fct_samples)
+            obs_trace.event("contrib.trust", **trust)
+            print(f"[bench] trust: K={trust['ensemble']} "
+                  f"kendall_tau={trust['kendall_tau']:.3f}",
+                  file=sys.stderr, flush=True)
     elapsed = time.perf_counter() - t0
     assert timed.first_charac_fct_calls_count == B
 

@@ -141,6 +141,24 @@ RETRY_BACKOFF_ENV = "MPLC_TPU_RETRY_BACKOFF_SEC"
 MAX_CAP_HALVINGS_ENV = "MPLC_TPU_MAX_CAP_HALVINGS"
 RETRY_BACKOFF_CAP_SEC = 30.0  # bound on a single backoff sleep
 
+# Partner-level fault model + trust-calibrated answers (read at
+# ENGINE-CONSTRUCTION time, same warn+fallback contract as the
+# fault-tolerance knobs above):
+#   MPLC_TPU_PARTNER_FAULT_PLAN  deterministic partner-misbehavior plan —
+#                                dropout/straggler/noisy/glabel entries
+#                                (grammar in faults.py). Changes the GAME
+#                                (v(S) itself), so it is part of the
+#                                coalition-cache fingerprint.
+#   MPLC_TPU_SEED_ENSEMBLE       K > 1 trains K seed replicas of every
+#                                coalition as extra slot-batch rows through
+#                                the same merged buckets (one sweep's
+#                                dispatch cost, K x rows), making variance
+#                                a first-class output: per-partner Shapley
+#                                confidence intervals + a Kendall-tau
+#                                rank-stability score in the sweep report.
+PARTNER_FAULT_PLAN_ENV = "MPLC_TPU_PARTNER_FAULT_PLAN"
+SEED_ENSEMBLE_ENV = "MPLC_TPU_SEED_ENSEMBLE"
+
 # ---------------------------------------------------------------------------
 # Env-knob registry. EVERY `MPLC_TPU_*` env var the framework reads must be
 # registered here with its class — tests/test_knob_hygiene.py greps the
@@ -167,7 +185,9 @@ ENV_KNOBS = {
     "MPLC_TPU_MAX_CAP_HALVINGS": "workload",
     "MPLC_TPU_MAX_RETRIES": "workload",
     "MPLC_TPU_NO_SLOTS": "workload",
+    "MPLC_TPU_PARTNER_FAULT_PLAN": "workload",
     "MPLC_TPU_PARTNER_SHARDS": "workload",
+    "MPLC_TPU_SEED_ENSEMBLE": "workload",
     "MPLC_TPU_PIPELINE_BATCHES": "workload",
     "MPLC_TPU_RETRY_BACKOFF_SEC": "workload",
     "MPLC_TPU_SLOT_MERGE": "workload",

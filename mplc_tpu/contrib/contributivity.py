@@ -51,7 +51,8 @@ from ..obs import trace as obs_trace
 from .engine import CharacteristicEngine
 from .sampling import (WithoutReplacementRanks, make_importance_sampler,
                        randbelow, unrank_combination)
-from .shapley import powerset_order, shapley_from_characteristic
+from .shapley import (powerset_order, shapley_from_characteristic,
+                      trust_summary)
 
 logger = logging.getLogger("mplc_tpu")
 
@@ -119,6 +120,10 @@ class Contributivity:
         self.scores_std = np.zeros(nb_partners)
         self.normalized_scores = np.zeros(nb_partners)
         self.computation_time_sec = 0.0
+        # seed-ensemble trust row (per-partner CI + Kendall-tau rank
+        # stability) — populated by compute_SV when the engine runs with
+        # seed_ensemble > 1, None otherwise
+        self.trust = None
         # engine is shared per scenario so the coalition cache persists
         # across methods (same behavior as the reference's per-Contributivity
         # cache, but stronger: shared across methods in one scenario run).
@@ -195,7 +200,21 @@ class Contributivity:
         coalitions = powerset_order(n)
         self.engine.evaluate(coalitions)  # batched prefetch of all 2^n - 1
         sv = shapley_from_characteristic(n, self.engine.charac_fct_values)
-        self._finish("Shapley", sv, np.zeros(n), t0)
+        std = np.zeros(n)
+        samples = getattr(self.engine, "charac_fct_samples", None)
+        if getattr(self.engine, "seed_ensemble", 1) > 1 and samples:
+            # trust calibration: per-replica Shapley values from the K
+            # seed replicas the sweep batched alongside the point run —
+            # CI + rank stability become the report's `trust` row, and the
+            # replica std is the honest scores_std (the point path's zeros
+            # claim a certainty the volatility results refute)
+            self.trust = trust_summary(n, samples)
+            std = np.asarray(self.trust["std"])
+            obs_trace.event("contrib.trust", **self.trust)
+            logger.info(
+                "# Seed-ensemble trust: K=%d, kendall_tau=%.3f",
+                self.trust["ensemble"], self.trust["kendall_tau"])
+        self._finish("Shapley", sv, std, t0)
 
     # ------------------------------------------------------------------
     # 2. independent scores

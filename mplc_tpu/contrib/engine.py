@@ -94,6 +94,25 @@ def _fold_bitmask_keys(seed_key: jax.Array, words: jax.Array,
     return jax.vmap(one)(words, n_words)
 
 
+@jax.jit
+def _fold_bitmask_keys_seeded(seed_keys: jax.Array, words: jax.Array,
+                              n_words: jax.Array) -> jax.Array:
+    """Seed-ensemble variant of `_fold_bitmask_keys`: a PER-ROW seed key
+    ([B, 2]) instead of one shared key, so a batch can mix replicas of the
+    same coalition under different base seeds. Replica 0 rows carry the
+    engine's base key and produce streams bit-identical to the shared-key
+    fold (equality-tested)."""
+    W = words.shape[1]
+
+    def one(key, wrow, n):
+        for w in range(W):
+            folded = jax.random.fold_in(key, wrow[w])
+            key = jnp.where(w < n, folded, key)
+        return key
+
+    return jax.vmap(one)(seed_keys, words, n_words)
+
+
 class BatchedTrainerPipeline:
     """Jitted init -> epoch-chunk -> finalize pipeline, vmapped over coalitions."""
 
@@ -235,12 +254,85 @@ class Batched2DTrainerPipeline(BatchedTrainerPipeline):
 class CharacteristicEngine:
     """Memoizing, batching, device-sharding characteristic function v(S)."""
 
-    def __init__(self, scenario, share_data_from: "CharacteristicEngine | None" = None):
+    # class-level defaults so engine subclasses that bypass __init__ (the
+    # test suite's FakeEngine) still satisfy the ensemble/fault surface
+    seed_ensemble = 1
+    _partner_faults: dict = {}
+    _forever_dropped: frozenset = frozenset()
+
+    def __init__(self, scenario, share_data_from: "CharacteristicEngine | None" = None,
+                 seed_ensemble: int | None = None):
         self.scenario = scenario
         self.partners_list = sorted(scenario.partners_list, key=lambda p: p.id)
         self.partners_count = len(self.partners_list)
         self.model = scenario.dataset.model
         self.seed = getattr(scenario, "seed", 0)
+
+        # Partner-level fault model (MPLC_TPU_PARTNER_FAULT_PLAN,
+        # faults.py): dropout/straggler entries become static TrainConfig
+        # tuples compiled into the fedavg trainers below; noisy/glabel
+        # entries are data-plane and were already applied by
+        # Scenario.data_corruption. Partners dropped from epoch 1 never
+        # participate, so the per-coalition rng stream is canonicalized
+        # over the membership WITHOUT them — that is what makes a
+        # dropout@pK:epoch1 sweep bit-identical to the fault-free sweep
+        # of the partner-excluded coalitions (equality-tested).
+        stashed = getattr(scenario, "_partner_fault_plan", None)
+        if stashed is not None:
+            # Scenario.data_corruption already parsed (and clipped) the
+            # plan — reuse it so the fingerprint describes the exact plan
+            # whose data faults were applied, even if the env mutated
+            # since, and the clip warning fires once per run
+            self._partner_faults = stashed
+        else:
+            self._partner_faults = faults.clip_partner_plan(
+                faults.partner_fault_plan_from_env(), self.partners_count)
+        self._forever_dropped = faults.forever_dropped(self._partner_faults)
+        drop_epochs, straggler_delays = faults.trainer_fault_arrays(
+            self._partner_faults, self.partners_count)
+        if faults.data_fault_specs(self._partner_faults) and \
+                not getattr(scenario, "_data_faults_applied", False):
+            # trainer-plane entries are enforced right here, but
+            # noisy/glabel corruption happens in Scenario.data_corruption
+            # — a direct-engine caller that skipped it would compute a
+            # CLEAN game while the cache fingerprint names the plan (the
+            # data digest still refuses cross-run reuse, but the mislabel
+            # deserves a loud warning at the source)
+            import warnings
+            warnings.warn(
+                f"{faults.PARTNER_FAULT_PLAN_ENV} carries data-plane "
+                "(noisy/glabel) entries but Scenario.data_corruption() "
+                "was never run — this engine is computing the UNcorrupted "
+                "game", stacklevel=2)
+
+        # Seed-ensemble sweeps (seed_ensemble=K / MPLC_TPU_SEED_ENSEMBLE):
+        # every coalition trains K replicas under K distinct base seeds,
+        # packed as EXTRA ROWS of the same slot-batch buckets — one
+        # sweep's dispatch structure, K x rows, not K sequential sweeps.
+        # Replica 0 uses the engine's base seed unchanged, so the point
+        # estimates (charac_fct_values) are bit-identical to a K=1 run;
+        # all replicas land in charac_fct_samples for CI / rank-stability
+        # reporting (contrib/shapley.trust_summary).
+        if seed_ensemble is not None:
+            if int(seed_ensemble) < 1:
+                raise ValueError(
+                    f"seed_ensemble must be >= 1, got {seed_ensemble}")
+            self.seed_ensemble = int(seed_ensemble)
+        else:
+            self.seed_ensemble = constants._env_positive_int(
+                constants.SEED_ENSEMBLE_ENV, 1)
+        base_key = jax.random.PRNGKey(self.seed)
+        if self.seed_ensemble > 1:
+            self._ensemble_rows = np.stack(
+                [np.asarray(base_key, np.uint32)]
+                + [np.asarray(jax.random.fold_in(base_key, 0x5EED0000 + j),
+                              np.uint32)
+                   for j in range(1, self.seed_ensemble)])
+        else:
+            self._ensemble_rows = None
+        # per-coalition replica values: {subset: np.ndarray [K]} (empty
+        # unless seed_ensemble > 1)
+        self.charac_fct_samples: dict[tuple, np.ndarray] = {}
 
         label_dim = self.model.label_dim()
         if share_data_from is not None:
@@ -282,6 +374,16 @@ class CharacteristicEngine:
             # only the one early-stopping column per epoch is evaluated
             record_val_history=False,
         )
+        if drop_epochs is not None or straggler_delays is not None:
+            if scenario.multi_partner_learning_approach_key != "fedavg":
+                raise ValueError(
+                    "MPLC_TPU_PARTNER_FAULT_PLAN dropout/straggler entries "
+                    "require the fedavg approach (their mask/renormalize "
+                    "and stale-params semantics are FedAvg aggregation "
+                    "semantics); got "
+                    f"'{scenario.multi_partner_learning_approach_key}'")
+            base.update(partner_drop_epochs=drop_epochs,
+                        partner_straggler_delays=straggler_delays)
         multi_cfg = TrainConfig(approach=scenario.multi_partner_learning_approach_key,
                                 **base)
         single_cfg = TrainConfig(approach="single", **base)
@@ -344,6 +446,10 @@ class CharacteristicEngine:
         # the mode actually run, even under the env override
         scenario.partner_shards = part_shards
         if part_shards > 1:
+            if self.seed_ensemble > 1:
+                raise ValueError(
+                    "seed-ensemble sweeps (MPLC_TPU_SEED_ENSEMBLE > 1) are "
+                    "not supported in the 2-D partner-sharded mode")
             n_dev = len(jax.devices())
             if multi_cfg.approach not in ("fedavg", "lflip"):
                 raise ValueError(
@@ -490,12 +596,42 @@ class CharacteristicEngine:
         return coal
 
     def _batch_rngs(self, words: np.ndarray, n_words: np.ndarray,
-                    sel: np.ndarray) -> jax.Array:
+                    sel: np.ndarray,
+                    seed_rows: np.ndarray | None = None) -> jax.Array:
         """[b, 2] per-coalition keys for one padded batch (rows selected by
         `sel` from the whole-call fold words), bit-identical to stacking
-        `_coalition_rng` per subset — equality-tested."""
+        `_coalition_rng` per subset — equality-tested. With `seed_rows`
+        (seed-ensemble sweeps) each row folds its OWN base key: replica-0
+        rows carry the engine key and reproduce the shared-key stream."""
+        if seed_rows is not None:
+            return _fold_bitmask_keys_seeded(jnp.asarray(seed_rows[sel]),
+                                             jnp.asarray(words[sel]),
+                                             jnp.asarray(n_words[sel]))
         return _fold_bitmask_keys(self._seed_key, jnp.asarray(words[sel]),
                                   jnp.asarray(n_words[sel]))
+
+    def _effective_subset(self, subset: tuple) -> tuple:
+        """The coalition's membership minus forever-dropped partners (the
+        rng-canonicalization set: a partner dropped from epoch 1 never
+        trains, so the stream must match the partner-excluded run's)."""
+        return tuple(i for i in subset if i not in self._forever_dropped)
+
+    def _incomplete(self, subset: tuple) -> bool:
+        """True when the subset still needs device work: no point estimate,
+        or (seed-ensemble) any replica row not yet harvested."""
+        if subset not in self.charac_fct_values:
+            return True
+        if self.seed_ensemble == 1:
+            return False
+        arr = self.charac_fct_samples.get(subset)
+        return arr is None or bool(np.isnan(arr).any())
+
+    def _store_sample(self, subset: tuple, rep: int, value: float) -> None:
+        arr = self.charac_fct_samples.get(subset)
+        if arr is None:
+            arr = self.charac_fct_samples[subset] = np.full(
+                self.seed_ensemble, np.nan)
+        arr[rep] = value
 
     def _device_batch_cap(self, slot_count: int | None = None,
                           overlap: bool = False) -> int:
@@ -665,7 +801,14 @@ class CharacteristicEngine:
             self._degrade_cap(e)
             if self._cpu_degraded and getattr(pipe, "coal_devices", None):
                 raise  # no CPU path for the partner-sharded 2-D programs
-            redo = [s for s in prev[0] if s not in self.charac_fct_values]
+            if prev[3].get("ensemble"):
+                # job-granular group: redo every subset with ANY replica
+                # still missing (the re-run re-trains all K replicas —
+                # deterministic streams make the overwrite a no-op)
+                subs = list(dict.fromkeys(s for s, _ in prev[0]))
+            else:
+                subs = prev[0]
+            redo = [s for s in subs if self._incomplete(s)]
             if redo:
                 self._run_batch(redo, pipe, slot_count)
 
@@ -676,6 +819,12 @@ class CharacteristicEngine:
         # degenerates to the sequential path and must not halve the cap
         overlap = self._pipeline_batches and pipe.dispatches_async
         is2d = bool(getattr(pipe, "coal_devices", None))
+        # seed-ensemble sweeps run at JOB granularity: K replica rows per
+        # subset ride the same buckets (the padding rows a single-seed
+        # sweep wastes absorb them, so the dispatch count grows
+        # sub-linearly in K — asserted via the engine.batches counter)
+        K = self.seed_ensemble
+        n_jobs = len(subsets) * K
 
         def bucket_width() -> int:
             # ONE bucket width for the whole call (the tail group pads up
@@ -693,7 +842,7 @@ class CharacteristicEngine:
                 n_dev = max(
                     self._sharding.num_devices if self._sharding else 1, 1)
                 cap = self._device_batch_cap(slot_count, overlap)
-            return _bucket_size(min(len(subsets), n_dev * cap), n_dev, cap)
+            return _bucket_size(min(n_jobs, n_dev * cap), n_dev, cap)
 
         b = bucket_width()
         halvings_seen = self._cap_halvings
@@ -711,15 +860,35 @@ class CharacteristicEngine:
         # one NumPy scatter builds every coalition row and every rng fold
         # word; per-batch work below shrinks to an index select + one
         # vmapped fold — the host-side share of the dispatch gap.
-        with obs_trace.span("engine.prep", coalitions=len(subsets),
+        with obs_trace.span("engine.prep", coalitions=n_jobs,
                             width=b, slot_count=slot_count):
-            coal_all = self._coalition_arrays(subsets, slot_count)
-            words, n_words = self._rng_fold_words(subsets)
+            # rng streams are keyed by the EFFECTIVE membership (minus
+            # forever-dropped partners), the identity for fault-free runs.
+            # The single trainer additionally takes the effective mask (its
+            # argmax must find the lone SURVIVOR; there is no aggregation
+            # to renormalize) — the multi/slot trainers keep the full
+            # membership and mask the dropped slot out in-trainer.
+            eff = ([self._effective_subset(s) for s in subsets]
+                   if self._forever_dropped else subsets)
+            coal_all = self._coalition_arrays(
+                eff if pipe is self.single_pipe else subsets, slot_count)
+            words, n_words = self._rng_fold_words(eff)
+            if K > 1:
+                sub_idx = np.repeat(np.arange(len(subsets)), K)
+                coal_all = coal_all[sub_idx]
+                words = words[sub_idx]
+                n_words = n_words[sub_idx]
+                seed_rows = self._ensemble_rows[
+                    np.tile(np.arange(K), len(subsets))]
+                jobs = [(s, j) for s in subsets for j in range(K)]
+            else:
+                seed_rows = None
+                jobs = subsets
 
         pending = None  # (group, fetch-thunk, remaining-after, meta) in flight
         try:
             i = 0
-            while i < len(subsets):
+            while i < n_jobs:
                 if self._cpu_degraded and not is2d:
                     # OOM ladder exhausted: drain the in-flight batch
                     # (its own fetch may OOM too — the recover path routes
@@ -729,9 +898,9 @@ class CharacteristicEngine:
                         prev, pending = pending, None
                         self._record_or_recover(prev, per_partner,
                                                 slot_count, pipe)
-                    self._run_groups_cpu(subsets, i, coal_all, words, n_words,
+                    self._run_groups_cpu(jobs, i, coal_all, words, n_words,
                                          pipe, slot_count, per_partner,
-                                         passes_per_mb)
+                                         passes_per_mb, seed_rows=seed_rows)
                     return
                 if self._cap_halvings != halvings_seen:
                     # an OOM (here or inside a harvest recovery) stepped the
@@ -739,7 +908,7 @@ class CharacteristicEngine:
                     # the ordinary width machinery at the degraded cap
                     halvings_seen = self._cap_halvings
                     b = bucket_width()
-                group = subsets[i:i + b]
+                group = jobs[i:i + b]
                 # padding rows replicate the batch's first coalition (the
                 # same convention the old per-batch fill loop used)
                 sel = np.full(b, i, np.intp)
@@ -750,13 +919,15 @@ class CharacteristicEngine:
                 meta = {**attrs, "t0": time.perf_counter(),
                         "passes_per_mb": passes_per_mb,
                         "mb_count": pipe.trainer.cfg.minibatch_count,
-                        "ordinal": self._batch_ordinal}
+                        "ordinal": self._batch_ordinal,
+                        "ensemble": K > 1}
 
                 def dispatch(sel=sel, attrs=attrs,
                              ordinal=self._batch_ordinal):
                     with obs_trace.span("engine.dispatch", **attrs):
                         self._faults.check("dispatch", ordinal)
-                        rngs = self._batch_rngs(words, n_words, sel)
+                        rngs = self._batch_rngs(words, n_words, sel,
+                                                seed_rows)
                         coal = jnp.asarray(coal_all[sel])
                         if getattr(pipe, "batch_sharding", None) is not None:
                             coal = jax.device_put(coal, pipe.batch_sharding)
@@ -804,10 +975,10 @@ class CharacteristicEngine:
                         prev, pending = pending, None
                         self._record_or_recover(prev, per_partner,
                                                 slot_count, pipe)
-                    pending = (group, fetch, len(subsets) - i, meta)
+                    pending = (group, fetch, n_jobs - i, meta)
                 else:
                     self._record_or_recover(
-                        (group, fetch, len(subsets) - i, meta),
+                        (group, fetch, n_jobs - i, meta),
                         per_partner, slot_count, pipe)
             if pending is not None:
                 # normal-exit drain: the last in-flight batch still gets
@@ -824,15 +995,18 @@ class CharacteristicEngine:
                 prev, pending = pending, None
                 self._record_group(*prev, per_partner, slot_count)
 
-    def _run_groups_cpu(self, subsets, start, coal_all, words, n_words,
-                        pipe, slot_count, per_partner, passes_per_mb) -> None:
+    def _run_groups_cpu(self, jobs, start, coal_all, words, n_words,
+                        pipe, slot_count, per_partner, passes_per_mb,
+                        seed_rows=None) -> None:
         """Terminal rung of the OOM ladder: train the remaining groups one
         small batch at a time on the host CPU backend instead of
         abandoning the run (bench's process-level fallback restarts the
         whole workload at reduced scale; here everything already harvested
         is kept and only the tail pays CPU speed). Row-independent vmapped
         training makes the CPU values bit-identical to the device path's —
-        equality-tested under injected faults."""
+        equality-tested under injected faults. `jobs` are subsets, or
+        (subset, replica) pairs under a seed ensemble — the caller's
+        job-expanded `coal_all`/`words`/`seed_rows` arrays line up."""
         cpu_dev = jax.local_devices(backend="cpu")[0]
         if self._cpu_data is None:
             self._cpu_data = tuple(
@@ -840,10 +1014,10 @@ class CharacteristicEngine:
                 for d in (self.stacked, self.val, self.test))
         stacked, val, test = self._cpu_data
         cap = self._device_batch_cap(slot_count, False)
-        b = _bucket_size(min(len(subsets) - start, cap), 1, cap)
+        b = _bucket_size(min(len(jobs) - start, cap), 1, cap)
         i = start
-        while i < len(subsets):
-            group = subsets[i:i + b]
+        while i < len(jobs):
+            group = jobs[i:i + b]
             sel = np.full(b, i, np.intp)
             sel[:len(group)] = np.arange(i, i + len(group))
             i += len(group)
@@ -854,13 +1028,15 @@ class CharacteristicEngine:
             meta = {**attrs, "t0": time.perf_counter(),
                     "passes_per_mb": passes_per_mb,
                     "mb_count": pipe.trainer.cfg.minibatch_count,
-                    "ordinal": self._batch_ordinal}
+                    "ordinal": self._batch_ordinal,
+                    "ensemble": seed_rows is not None}
 
             def dispatch(sel=sel, attrs=attrs, ordinal=self._batch_ordinal):
                 with obs_trace.span("engine.dispatch", **attrs):
                     self._faults.check("dispatch", ordinal)
                     rngs = jax.device_put(
-                        self._batch_rngs(words, n_words, sel), cpu_dev)
+                        self._batch_rngs(words, n_words, sel, seed_rows),
+                        cpu_dev)
                     coal = jax.device_put(jnp.asarray(coal_all[sel]), cpu_dev)
                     with jax.default_device(cpu_dev):
                         return pipe.scores_async(coal, rngs, stacked, val,
@@ -868,7 +1044,7 @@ class CharacteristicEngine:
 
             meta["redispatch"] = dispatch
             fetch = self._retry_transient(dispatch, "dispatch")
-            self._record_group(group, fetch, len(subsets) - i, meta,
+            self._record_group(group, fetch, len(jobs) - i, meta,
                                per_partner, slot_count)
 
     def _record_group(self, group, fetch, remaining, meta, per_partner,
@@ -882,13 +1058,34 @@ class CharacteristicEngine:
             accs, epochs = self._fetch_with_retry(fetch, meta)
         batch_epochs = 0
         batch_samples = 0
-        for s, acc, ep in zip(group, accs[:len(group)], epochs[:len(group)]):
-            self._store(s, float(acc))
+        ensemble = bool(meta.get("ensemble"))
+        for item, acc, ep in zip(group, accs[:len(group)],
+                                 epochs[:len(group)]):
+            if ensemble:
+                # job-granular row: (subset, replica). Replica 0 carries
+                # the base-seed stream and IS the point estimate — the
+                # extra replicas only feed charac_fct_samples. The
+                # already-stored guard matters on the OOM-recovery redo
+                # path (and ensemble resume): a subset whose replica rows
+                # straddled batches can re-run ALL its replicas, and a
+                # second _store of the (bit-identical) replica-0 value
+                # would inflate first_charac_fct_calls_count.
+                s, rep = item
+                self._store_sample(s, int(rep), float(acc))
+                if rep == 0 and s not in self.charac_fct_values:
+                    self._store(s, float(acc))
+            else:
+                s = item
+                self._store(s, float(acc))
             batch_epochs += int(ep)
+            # throughput accounting over partners that actually trained:
+            # forever-dropped members consumed zero samples
             batch_samples += int(ep) * int(
-                sum(int(per_partner[i]) for i in s))
+                sum(int(per_partner[i])
+                    for i in self._effective_subset(s)))
         self.epochs_trained += batch_epochs
         self.samples_trained += batch_samples
+        obs_metrics.counter("engine.batches").inc()
         # partner passes executed on device for this batch, INCLUDING the
         # padded/inactive slot or mask rows (what the hardware ran, not just
         # the useful share): epochs x minibatches x passes-per-minibatch,
@@ -1083,16 +1280,47 @@ class CharacteristicEngine:
         partner indices). Returns values in input order."""
         keys = [tuple(sorted(int(i) for i in s)) for s in subsets]
         unique = dict.fromkeys(keys)  # stable-unique
-        missing = [k for k in unique if k not in self.charac_fct_values]
+        missing = [k for k in unique if self._incomplete(k)]
+        n_requested_missing = len(missing)
+        if self._forever_dropped:
+            # a coalition whose EVERY member is dropped from epoch 1 never
+            # produces a model: its value is v(empty) = 0 by definition —
+            # stored without training (and the deviation that makes the
+            # dropped partner an exact null player, so the faulty game's
+            # Shapley values equal the partner-excluded game's)
+            live = []
+            for k in missing:
+                if all(i in self._forever_dropped for i in k):
+                    self._store(k, 0.0)
+                    if self.seed_ensemble > 1:
+                        self.charac_fct_samples[k] = np.zeros(
+                            self.seed_ensemble)
+                else:
+                    live.append(k)
+            missing = live
+            # null coalitions are neither memo hits (nothing was cached)
+            # nor misses (nothing trains) — their own bucket keeps the
+            # memo hit rate an honest before/after for perf PRs
+            obs_metrics.counter("engine.null_coalitions").inc(
+                n_requested_missing - len(missing))
         # memo accounting over unique keys: intra-call duplicates don't
         # inflate the hit rate
-        obs_metrics.counter("engine.memo_hits").inc(len(unique) - len(missing))
+        obs_metrics.counter("engine.memo_hits").inc(
+            len(unique) - n_requested_missing)
         obs_metrics.counter("engine.memo_misses").inc(len(missing))
         obs_metrics.counter("engine.coalitions_evaluated").inc(len(missing))
         with obs_trace.span("engine.evaluate", requested=len(unique),
                             missing=len(missing)):
-            singles = [k for k in missing if len(k) == 1]
-            multis = [k for k in missing if len(k) > 1]
+            if self._forever_dropped:
+                # route by EFFECTIVE size: a coalition reduced to one
+                # survivor is a single-partner training (the reference's
+                # SinglePartnerLearning routing applies to who actually
+                # trains, not to who enrolled)
+                lens = {k: len(self._effective_subset(k)) for k in missing}
+            else:
+                lens = {k: len(k) for k in missing}
+            singles = [k for k in missing if lens[k] == 1]
+            multis = [k for k in missing if lens[k] > 1]
             if singles:
                 if self._pipe2d is not None:
                     self._run_singles_sliced(singles)
@@ -1207,6 +1435,13 @@ class CharacteristicEngine:
             # the wide-step deviation changes every trajectory at mult > 1:
             # a cache built under one mult describes a different game
             "step_width_mult": cfg.step_width_mult,
+            # a partner-fault plan changes v(S) itself (dropped/straggling
+            # partners train differently), so any two distinct plans
+            # describe different games; the ensemble width changes what a
+            # cache's sample rows mean
+            "partner_fault_plan": faults.normalized_plan_repr(
+                self._partner_faults),
+            "seed_ensemble": self.seed_ensemble,
             "compute_dtype": cfg.compute_dtype,
             "split": [str(getattr(sc, "samples_split_type", "?")),
                       str(getattr(sc, "samples_split_description", "?"))],
@@ -1239,6 +1474,13 @@ class CharacteristicEngine:
             "increments_values": [[[list(k), v] for k, v in d.items()]
                                   for d in self.increments_values],
         }
+        samples = getattr(self, "charac_fct_samples", None)
+        if samples:
+            # seed-ensemble replica rows (NaN = not yet harvested; resume
+            # re-trains any subset with an incomplete row)
+            payload["charac_fct_samples"] = [
+                [list(k), [float(v) for v in arr]]
+                for k, arr in samples.items()]
         # checksum over the payload's own serialization: verification
         # re-derives the same bytes from the parsed document (json dict
         # order and float repr both round-trip), so no second file or
@@ -1302,8 +1544,11 @@ class CharacteristicEngine:
                 f"coalition cache {path} is missing keys {sorted(missing)}")
         theirs = payload.get("fingerprint", {})
         # caches saved before the wide-step knob existed ran at the only
-        # stepping there was — today's mult=1
+        # stepping there was — today's mult=1; likewise pre-fault-plan /
+        # pre-ensemble caches described the fault-free single-seed game
         theirs.setdefault("step_width_mult", 1)
+        theirs.setdefault("partner_fault_plan", "")
+        theirs.setdefault("seed_ensemble", 1)
         ours = self._fingerprint()
         if "partners_count" in theirs and \
                 theirs["partners_count"] != ours["partners_count"]:
@@ -1322,3 +1567,6 @@ class CharacteristicEngine:
         self.increments_values = [{tuple(k): v for k, v in entries}
                                   for entries in payload["increments_values"]]
         self.first_charac_fct_calls_count = payload["first_charac_fct_calls_count"]
+        self.charac_fct_samples = {
+            tuple(k): np.asarray(v, float)
+            for k, v in payload.get("charac_fct_samples", [])}

@@ -4,6 +4,14 @@ Replaces the reference's vendored susobhang70 implementation
 (/root/reference/mplc/contributivity.py:1205-1253) — which rebuilds the
 power set and calls `list.index` per term (O(4^n) lookups) — with direct
 bit-twiddling over coalition bitmasks: O(n·2^n) with O(1) lookups.
+
+Trust calibration: "On the Volatility of Shapley-Based Contribution
+Metrics in Federated Learning" (PAPERS.md) shows point Shapley estimates
+— and especially the partner RANKINGS derived from them — flip across
+seeds. The seed-ensemble helpers below turn a K-replica characteristic
+table (CharacteristicEngine's `charac_fct_samples`) into per-partner
+confidence intervals and a Kendall-tau rank-stability score, rendered as
+the `trust` row of the sweep report.
 """
 
 from __future__ import annotations
@@ -56,3 +64,94 @@ def shapley_from_characteristic(n: int, value_of: dict) -> np.ndarray:
             if not (mask >> i) & 1:
                 sv[i] += weights[size] * (v[mask | (1 << i)] - v[mask])
     return sv
+
+
+# ---------------------------------------------------------------------------
+# Seed-ensemble trust calibration: CI + rank stability over K replicas
+# ---------------------------------------------------------------------------
+
+def shapley_sample_matrix(n: int, samples_of: dict) -> np.ndarray:
+    """[K, n] per-replica Shapley values from a replica-valued
+    characteristic table (`samples_of`: sorted subset tuple -> [K] array,
+    CharacteristicEngine.charac_fct_samples). Replica j's Shapley vector
+    is computed from replica j's v(S) slice — K independent games, one
+    table."""
+    if not samples_of:
+        raise ValueError("empty replica table — run a seed-ensemble sweep "
+                         "(seed_ensemble > 1) first")
+    K = len(next(iter(samples_of.values())))
+    rows = []
+    for j in range(K):
+        rows.append(shapley_from_characteristic(
+            n, {s: float(arr[j]) for s, arr in samples_of.items()}))
+    return np.stack(rows)
+
+
+def kendall_tau(a, b) -> float:
+    """Kendall's tau-a between the rankings induced by two score vectors:
+    (concordant - discordant) / (n choose 2) over all index pairs. Ties
+    count as discordant-free zeros; n < 2 returns 1.0 (a single partner
+    cannot be mis-ranked)."""
+    a = np.asarray(a, float)
+    b = np.asarray(b, float)
+    n = len(a)
+    if n < 2:
+        return 1.0
+    conc = disc = 0
+    for i in range(n):
+        for j in range(i + 1, n):
+            s = (a[i] - a[j]) * (b[i] - b[j])
+            if s > 0:
+                conc += 1
+            elif s < 0:
+                disc += 1
+    return (conc - disc) / (n * (n - 1) / 2)
+
+
+def rank_stability(sv_samples: np.ndarray) -> float:
+    """Mean pairwise Kendall tau across the K replicas' Shapley rankings:
+    1.0 = every seed agrees on the partner ordering, values near 0 = the
+    ranking is noise (the volatility failure mode). K = 1 returns 1.0."""
+    K = sv_samples.shape[0]
+    if K < 2:
+        return 1.0
+    taus = [kendall_tau(sv_samples[i], sv_samples[j])
+            for i in range(K) for j in range(i + 1, K)]
+    return float(np.mean(taus))
+
+
+def confidence_intervals(sv_samples: np.ndarray, alpha: float = 0.95
+                         ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(mean, ci_low, ci_high) per partner over the K replica Shapley
+    vectors: a Student-t interval on the mean at confidence `alpha`
+    (half-width t_{K-1} * s / sqrt(K)). K = 1 collapses to zero-width
+    intervals at the point estimate."""
+    sv_samples = np.asarray(sv_samples, float)
+    K = sv_samples.shape[0]
+    mean = sv_samples.mean(axis=0)
+    if K < 2:
+        return mean, mean.copy(), mean.copy()
+    from scipy.stats import t
+    half = (t.ppf(0.5 + alpha / 2.0, K - 1)
+            * sv_samples.std(axis=0, ddof=1) / np.sqrt(K))
+    return mean, mean - half, mean + half
+
+
+def trust_summary(n: int, samples_of: dict, alpha: float = 0.95) -> dict:
+    """The sweep report's `trust` row: per-partner Shapley mean / std /
+    CI bounds over the seed ensemble plus the Kendall-tau rank-stability
+    score. Plain lists and floats — JSON-ready for the telemetry
+    sidecar."""
+    sv = shapley_sample_matrix(n, samples_of)
+    mean, lo, hi = confidence_intervals(sv, alpha)
+    std = (sv.std(axis=0, ddof=1) if sv.shape[0] > 1
+           else np.zeros(n))
+    return {
+        "ensemble": int(sv.shape[0]),
+        "alpha": float(alpha),
+        "mean": [float(x) for x in mean],
+        "std": [float(x) for x in std],
+        "ci_low": [float(x) for x in lo],
+        "ci_high": [float(x) for x in hi],
+        "kendall_tau": rank_stability(sv),
+    }

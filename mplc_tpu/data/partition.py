@@ -217,7 +217,14 @@ class StackedPartners(NamedTuple):
         P = len(partners_list)
         n_max = max(len(p.x_train) for p in partners_list)
         x0 = np.asarray(partners_list[0].x_train)
-        x_dtype = np.int32 if np.issubdtype(x0.dtype, np.integer) else np.float32
+        # int32 (token ids) only when EVERY partner's features are
+        # integer: per-partner corruption ('noisy' feature noise) can
+        # float one silo's features, and deciding from partner 0 alone
+        # would silently truncate the others' values back to ints
+        x_dtype = (np.int32
+                   if all(np.issubdtype(np.asarray(p.x_train).dtype,
+                                        np.integer) for p in partners_list)
+                   else np.float32)
         x = np.zeros((P, n_max) + x0.shape[1:], x_dtype)
         y = np.zeros((P, n_max, label_dim), np.float32)
         mask = np.zeros((P, n_max), np.float32)

@@ -19,6 +19,22 @@ import numpy as np
 from .. import constants
 from .datasets import to_categorical
 
+# The corruption vocabulary (`Scenario.corrupted_datasets` entries).
+# Scenario validates specs against this list at CONSTRUCTION — an unknown
+# name raises immediately with the valid options instead of silently
+# running an uncorrupted partner through a "corrupted" scenario.
+#   not_corrupted  leave the partner alone
+#   corrupted      offset labels by one class (deterministic attack)
+#   shuffled       per-row shuffle of the one-hot vector
+#   permuted       a random fixed K x K class permutation
+#   random         resample labels from a per-class Dirichlet row
+#   noisy          seeded Gaussian noise on the FEATURES (sigma = spec
+#                  parameter) — the feature-skew / sensor-degradation silo
+#   glabel         flip a fraction of labels to ONE seeded global target
+#                  class — the targeted label-poisoning attack
+CORRUPTION_KINDS = ("not_corrupted", "corrupted", "shuffled", "permuted",
+                    "random", "noisy", "glabel")
+
 
 def _ensure_categorical(y: np.ndarray) -> tuple[np.ndarray, bool]:
     """Reference `_Decorator.categorical_needed`
@@ -111,4 +127,35 @@ class Partner:
         idx = self._rng.choice(len(y), size=n, replace=False)
         for i in idx:
             self._rng.shuffle(y[i])
+        self.y_train = np.argmax(y, axis=1) if demote else y
+
+    def noisy_features(self, sigma: float = 0.1):
+        """Seeded Gaussian noise on the train FEATURES: x += N(0, sigma).
+        The feature-plane corruption family ('noisy') — degraded sensors,
+        preprocessing drift — as opposed to the label attacks above.
+        Integer feature spaces (token ids) cannot absorb additive noise."""
+        if sigma < 0:
+            raise ValueError(f"noise sigma must be >= 0, got {sigma}")
+        x = np.asarray(self.x_train)
+        if np.issubdtype(x.dtype, np.integer):
+            raise ValueError(
+                "'noisy' corruption requires float features; partner "
+                f"{self.id}'s features are {x.dtype} (token ids?)")
+        self.x_train = (x + self._rng.normal(0.0, sigma, x.shape)
+                        ).astype(x.dtype, copy=False)
+
+    def flip_to_global_label(self, proportion_corrupted: float = 1.0):
+        """'glabel': flip a fraction of rows to ONE seeded target class —
+        the targeted poisoning attack (every corrupted sample claims the
+        same label), strictly harder to down-rank than uniform noise
+        because the corrupted silo is self-consistent."""
+        self._check_proportion(proportion_corrupted)
+        y, demote = _ensure_categorical(self.y_train)
+        n = int(len(y) * proportion_corrupted)
+        idx = self._rng.choice(len(y), size=n, replace=False)
+        target = int(self._rng.integers(y.shape[1]))
+        y[idx] = 0.0
+        y[idx, target] = 1.0
+        self.corruption_matrix = np.zeros((y.shape[1], y.shape[1]))
+        self.corruption_matrix[:, target] = 1.0
         self.y_train = np.argmax(y, axis=1) if demote else y

@@ -25,6 +25,34 @@ Repeating an entry queues multiple faults at the same boundary
 (`transient@batch1,transient@batch1` fails the first attempt AND the
 first retry). Each entry fires exactly once.
 
+Partner-level fault plan (`MPLC_TPU_PARTNER_FAULT_PLAN`). Where the plan
+above injects *infrastructure* failures at batch boundaries, this plan
+injects *partner* misbehavior — the cross-silo failure modes (stragglers,
+dropouts, corrupted silos) that change the GAME, not the schedule.
+Comma-separated entries
+
+    <kind>@p<ID>:<param><value>
+
+      dropout@p2:epoch3     partner 2 leaves at epoch 3 (1-based) and never
+                            returns; its slot is masked out with FedAvg
+                            weight renormalization over the survivors.
+                            `epoch1` = the partner never participates.
+      straggler@p0:delay2   partner 0's per-round contribution is computed
+                            from the global params 2 aggregation rounds
+                            stale (delay-k staleness, k >= 1).
+      noisy@p1:sigma0.1     seeded Gaussian feature noise (sigma = 0.1) on
+                            partner 1's training features (data plane:
+                            applied at Scenario.data_corruption time).
+      glabel@p3:frac0.5     50% of partner 3's labels flipped to one
+                            seeded "global" target class (the targeted
+                            label-poisoning attack; data plane).
+
+Entries are deterministic: dropout/straggler fire by partner id +
+epoch/round ordinal inside the compiled trainer, noisy/glabel draw from
+the partner's seeded generator. A repeated (kind, partner) pair warns and
+keeps the first entry; malformed entries warn and are skipped — same
+contract as the batch-fault plan.
+
 Injected exception classes mirror the real failures' types so the
 engine's classifier code paths are the ones exercised:
 
@@ -47,6 +75,7 @@ import re
 import warnings
 
 FAULT_PLAN_ENV = "MPLC_TPU_FAULT_PLAN"
+PARTNER_FAULT_PLAN_ENV = "MPLC_TPU_PARTNER_FAULT_PLAN"
 
 try:  # the concrete class jax raises for device/runtime failures
     from jaxlib.xla_extension import XlaRuntimeError as _XlaRuntimeError
@@ -183,3 +212,127 @@ class FaultInjector:
             raise InjectedOom(
                 f"RESOURCE_EXHAUSTED: injected device OOM {where}")
         raise InjectedCrash(f"injected crash {where}")
+
+
+# ---------------------------------------------------------------------------
+# Partner-level fault plan (MPLC_TPU_PARTNER_FAULT_PLAN)
+# ---------------------------------------------------------------------------
+
+# kind -> (expected param name, value parser, validator). dropout's epoch
+# and straggler's delay are 1-based ordinals; noisy's sigma is a noise
+# stddev; glabel's frac is a corrupted-label fraction.
+_PARTNER_KINDS = {
+    "dropout": ("epoch", int, lambda v: v >= 1),
+    "straggler": ("delay", int, lambda v: v >= 1),
+    "noisy": ("sigma", float, lambda v: v >= 0.0),
+    "glabel": ("frac", float, lambda v: 0.0 <= v <= 1.0),
+}
+
+_PARTNER_ENTRY_RE = re.compile(
+    r"^(dropout|straggler|noisy|glabel)@p([0-9]+):"
+    r"(epoch|delay|sigma|frac)([0-9]+(?:\.[0-9]+)?)$")
+
+
+def parse_partner_fault_plan(spec: str | None) -> dict:
+    """`{partner_id: {kind: value, ...}}` from the partner-plan grammar.
+
+    Malformed entries (unknown kind, kind/param mismatch, out-of-range
+    value) warn and are dropped; a repeated (kind, partner) pair warns and
+    keeps the FIRST entry. An empty/unset spec is the empty plan — the
+    production no-op, same contract as `parse_fault_plan`."""
+    plan: dict = {}
+    if not spec:
+        return plan
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        m = _PARTNER_ENTRY_RE.match(entry)
+        if m is not None:
+            kind, pid, param, value = (m.group(1), int(m.group(2)),
+                                       m.group(3), m.group(4))
+            want_param, cast, ok = _PARTNER_KINDS[kind]
+            if param == want_param:
+                try:
+                    v = cast(value)
+                except ValueError:
+                    v = None
+                if v is not None and ok(v):
+                    if kind in plan.get(pid, {}):
+                        warnings.warn(
+                            f"{PARTNER_FAULT_PLAN_ENV}: duplicate "
+                            f"{kind}@p{pid} entry {entry!r} ignored "
+                            "(keeping the first)", stacklevel=2)
+                    else:
+                        plan.setdefault(pid, {})[kind] = v
+                    continue
+        warnings.warn(
+            f"{PARTNER_FAULT_PLAN_ENV}: ignoring malformed entry {entry!r} "
+            "(expected dropout@p<I>:epoch<N> | straggler@p<I>:delay<K> | "
+            "noisy@p<I>:sigma<F> | glabel@p<I>:frac<F>)", stacklevel=2)
+    return plan
+
+
+def partner_fault_plan_from_env() -> dict:
+    return parse_partner_fault_plan(os.environ.get(PARTNER_FAULT_PLAN_ENV))
+
+
+def clip_partner_plan(plan: dict, partners_count: int) -> dict:
+    """Drop (with a warning) entries addressing partner ids outside the
+    scenario — a plan written for a bigger game must degrade, not crash."""
+    bad = sorted(p for p in plan if p >= partners_count)
+    if bad:
+        warnings.warn(
+            f"{PARTNER_FAULT_PLAN_ENV}: ignoring entries for partner ids "
+            f"{bad} (scenario has {partners_count} partners)", stacklevel=2)
+    return {p: f for p, f in plan.items() if p < partners_count}
+
+
+def trainer_fault_arrays(plan: dict, partners_count: int
+                         ) -> tuple[tuple | None, tuple | None]:
+    """The trainer-plane view of a partner plan: per-partner
+    `(drop_epochs, straggler_delays)` tuples of length P (0 = no fault for
+    that partner), or None in a slot when NO partner carries that fault —
+    the None lets TrainConfig/compiled programs stay byte-identical to the
+    fault-free build."""
+    drops = [0] * partners_count
+    delays = [0] * partners_count
+    for pid, entry in plan.items():
+        drops[pid] = int(entry.get("dropout", 0))
+        delays[pid] = int(entry.get("straggler", 0))
+    return (tuple(drops) if any(drops) else None,
+            tuple(delays) if any(delays) else None)
+
+
+def data_fault_specs(plan: dict) -> dict:
+    """The data-plane view: `{partner_id: [(kind, value), ...]}` for the
+    corruption-style faults (noisy feature noise, glabel label poisoning),
+    applied by `Scenario.data_corruption` through the partner's seeded
+    generator."""
+    out: dict = {}
+    for pid, entry in plan.items():
+        specs = [(k, entry[k]) for k in ("noisy", "glabel") if k in entry]
+        if specs:
+            out[pid] = specs
+    return out
+
+
+def forever_dropped(plan: dict) -> frozenset:
+    """Partner ids dropped from epoch 1 — they never participate, so a
+    coalition containing one is, for rng purposes, the coalition without
+    it (the engine canonicalizes the per-coalition rng stream over this
+    set; that is what makes `dropout@pK:epoch1` runs BIT-IDENTICAL to
+    partner-excluded fault-free runs)."""
+    return frozenset(p for p, entry in plan.items()
+                     if entry.get("dropout") == 1)
+
+
+def normalized_plan_repr(plan: dict) -> str:
+    """Canonical string form of a parsed partner plan (sorted, stable) —
+    the cache-fingerprint field: a coalition cache built under one partner
+    fault plan describes a DIFFERENT game than any other plan's."""
+    parts = []
+    for pid in sorted(plan):
+        for kind in sorted(plan[pid]):
+            parts.append(f"{kind}@p{pid}:{plan[pid][kind]}")
+    return ",".join(parts)

@@ -143,6 +143,24 @@ class TrainConfig:
     # parity with the historical per-sub-batch stepping; >1 is a documented
     # deviation (ceil(gup/mult) optimizer updates per pass instead of gup).
     step_width_mult: int = constants.STEP_WIDTH_MULT
+    # Partner-level fault model (MPLC_TPU_PARTNER_FAULT_PLAN, parsed by the
+    # engine into these per-partner tuples; None = fault-free, and the
+    # compiled programs are byte-identical to the pre-fault build):
+    #   partner_drop_epochs[p]      1-based epoch at which partner p drops
+    #       out FOREVER (0 = never). Its slot is masked to exactly-zero
+    #       gradients from that epoch on and its aggregation weight is
+    #       zeroed, so FedAvg renormalizes over the survivors — a partner
+    #       dropped from epoch 1 trains bit-identically to a run that
+    #       excluded it outright (equality-tested).
+    #   partner_straggler_delays[p] staleness in aggregation rounds: the
+    #       partner's per-round local pass starts from the global params of
+    #       `delay` rounds ago (a rolling buffer of the last max(delay)
+    #       post-aggregation params rides the TrainState), and its late
+    #       result still joins the CURRENT round's aggregation.
+    # Both are static config — they shape the compiled program and the
+    # trainer-registry key, exactly like slot_count.
+    partner_drop_epochs: tuple | None = None
+    partner_straggler_delays: tuple | None = None
 
     def __post_init__(self):
         if self.approach not in APPROACH_NAMES:
@@ -156,6 +174,16 @@ class TrainConfig:
         if self.step_width_mult < 1:
             raise ValueError(
                 f"step_width_mult must be >= 1, got {self.step_width_mult}")
+        if self.partner_drop_epochs is not None or \
+                self.partner_straggler_delays is not None:
+            if self.approach not in ("fedavg", "single"):
+                raise ValueError(
+                    "partner-level dropout/straggler faults support fedavg "
+                    "coalition training (and the single-partner trainer) "
+                    f"only, got '{self.approach}'")
+            if self.partner_axis is not None:
+                raise ValueError("partner-level faults and partner-axis "
+                                 "sharding are mutually exclusive")
         if self.slot_count is not None:
             if self.approach not in ("fedavg", "seq-pure",
                                      "seq-with-final-agg", "seqavg"):
@@ -186,6 +214,9 @@ class TrainState(NamedTuple):
     val_loss_h: jax.Array    # [E, MB] global val loss history
     val_acc_h: jax.Array     # [E, MB]
     partner_h: jax.Array     # [4, P, E, MB]: loss, acc, val_loss, val_acc
+    stale: Any = ()          # [D, ...] rolling buffer of the last D post-
+                             # aggregation global params (straggler faults
+                             # only; () when no partner straggles)
 
 
 class EvalSet(NamedTuple):
@@ -307,6 +338,14 @@ class MplTrainer:
         else:
             theta = jnp.zeros((0,))
             theta_h = jnp.zeros((0,))
+        if cfg.approach == "fedavg" and cfg.partner_straggler_delays and \
+                any(cfg.partner_straggler_delays):
+            # straggler buffer: the last D post-aggregation global params,
+            # seeded with D copies of the init params (a round-r straggler
+            # older than the run so far trains from the initial model)
+            stale = broadcast(params, max(cfg.partner_straggler_delays))
+        else:
+            stale = ()
         return TrainState(
             params=params, opt_state=opt_state, theta=theta, theta_h=theta_h,
             epoch=jnp.zeros((), jnp.int32), done=jnp.zeros((), bool),
@@ -316,6 +355,7 @@ class MplTrainer:
             val_loss_h=jnp.full((E, MB), jnp.nan, jnp.float32),
             val_acc_h=jnp.full((E, MB), jnp.nan, jnp.float32),
             partner_h=jnp.full((4, partners_count, E, MB), jnp.nan, jnp.float32),
+            stale=stale,
         )
 
     # ------------------------------------------------------------------
@@ -522,6 +562,50 @@ class MplTrainer:
         """metrics: [4, P] (loss, acc, val_loss, val_acc) for this round."""
         return partner_h.at[:, :, e, mb_i].set(metrics)
 
+    # ------------------------------------------------------------------
+    # partner-level faults (dropout / straggler) — helpers shared by the
+    # masked and slot fedavg epochs and the single trainer. All three are
+    # STATIC no-ops when the config carries no fault plan: the compiled
+    # programs are byte-identical to the fault-free build.
+    # ------------------------------------------------------------------
+
+    @property
+    def _partner_faulted(self) -> bool:
+        cfg = self.cfg
+        return (cfg.partner_drop_epochs is not None
+                or cfg.partner_straggler_delays is not None)
+
+    def _drop_active(self, e, P: int) -> jax.Array:
+        """[P] 0/1 activity under the dropout plan for (0-based) epoch `e`:
+        partner p participates iff it never drops (entry 0) or the 1-based
+        epoch e+1 is still before its drop epoch. Exact 1.0/0.0 floats, so
+        multiplying an unaffected coalition mask leaves it bit-identical."""
+        if self.cfg.partner_drop_epochs is None:
+            return jnp.ones((P,), jnp.float32)
+        drop = jnp.asarray(self.cfg.partner_drop_epochs, jnp.int32)
+        return jnp.where(drop == 0, jnp.float32(1.0),
+                         (e + 1 < drop).astype(jnp.float32))
+
+    def _straggler_starts(self, params, stale):
+        """[P, ...]-stacked start params for the masked fedavg path:
+        partner p's local pass starts from the global params `delay_p`
+        aggregation rounds stale (rolling-buffer row delay_p - 1); delay 0
+        partners get exact copies of the current params. The per-partner
+        delays are static config, so the stack resolves at trace time."""
+        delays = self.cfg.partner_straggler_delays
+
+        def leaf(g, st):
+            return jnp.stack([g if d == 0 else st[d - 1] for d in delays], 0)
+
+        return jax.tree_util.tree_map(leaf, params, stale)
+
+    def _push_stale(self, stale, params):
+        """Advance the straggler buffer one aggregation round: the params
+        that were current at the round's START become staleness-1."""
+        return jax.tree_util.tree_map(
+            lambda st, g: jnp.concatenate([g[None], st[:-1]], axis=0),
+            stale, params)
+
     def _fedavg_epoch(self, state: TrainState, stacked, val: EvalSet,
                       coal_mask, rng) -> TrainState:
         cfg = self.cfg
@@ -536,9 +620,21 @@ class MplTrainer:
         lflip = cfg.approach == "lflip"
         n_max = stacked.x.shape[1]
         mb_cap = max(n_max // cfg.minibatch_count, 1)
+        # partner-level faults (fedavg only — post_init forbids the rest):
+        # the dropout plan zeroes dropped partners' activity for the whole
+        # epoch (exact-zero gradients + zero aggregation weight, so FedAvg
+        # renormalizes over the survivors), stragglers start their local
+        # pass from delay-stale global params via the TrainState buffer.
+        faulted = self._partner_faulted
+        act_mask = coal_mask * self._drop_active(e, P) if faulted \
+            else coal_mask
+        stragglers = faulted and bool(cfg.partner_straggler_delays)
 
         def mb_body(carry, mb_i):
-            params, theta, vl_h, va_h, p_h = carry
+            if stragglers:
+                params, theta, vl_h, va_h, p_h, stale = carry
+            else:
+                params, theta, vl_h, va_h, p_h = carry
             vl, va = self._maybe_val_eval(params, val, mb_i, es_col=0)
             vl_h = vl_h.at[e, mb_i].set(vl)
             va_h = va_h.at[e, mb_i].set(va)
@@ -560,13 +656,23 @@ class MplTrainer:
                     return p, new_theta, ls, ac
                 new_params, theta, losses, accs = jax.vmap(one)(
                     theta, stacked.x, stacked.y, perms, stacked.sizes, coal_mask, p_rngs)
+            elif stragglers:
+                starts = self._straggler_starts(params, stale)
+
+                def one(start_p, x_p, y_p, perm_p, size_p, act, r):
+                    p, _, ls, ac = self._partner_pass(
+                        start_p, x_p, y_p, perm_p, size_p, act, mb_i, r)
+                    return p, ls, ac
+                new_params, losses, accs = jax.vmap(one)(
+                    starts, stacked.x, stacked.y, perms, stacked.sizes,
+                    act_mask, p_rngs)
             else:
                 def one(x_p, y_p, perm_p, size_p, act, r):
                     p, _, ls, ac = self._partner_pass(
                         params, x_p, y_p, perm_p, size_p, act, mb_i, r)
                     return p, ls, ac
                 new_params, losses, accs = jax.vmap(one)(
-                    stacked.x, stacked.y, perms, stacked.sizes, coal_mask, p_rngs)
+                    stacked.x, stacked.y, perms, stacked.sizes, act_mask, p_rngs)
 
             need_pval = cfg.record_partner_val or cfg.aggregator == "local-score"
             if need_pval:
@@ -577,12 +683,27 @@ class MplTrainer:
             p_h = self._record_partner(p_h, e, mb_i,
                                        jnp.stack([losses, accs, pvl, pva]))
 
-            w = aggregation_weights(cfg.aggregator, coal_mask,
+            w = aggregation_weights(cfg.aggregator, act_mask,
                                     stacked.sizes, jnp.nan_to_num(pva),
                                     axis_name=cfg.partner_axis)
-            params = aggregate(new_params, w, axis_name=cfg.partner_axis)
-            return (params, theta, vl_h, va_h, p_h), None
+            agg = aggregate(new_params, w, axis_name=cfg.partner_axis)
+            if faulted:
+                # a round with zero survivors (every coalition member
+                # dropped) keeps the global params instead of aggregating
+                # an all-zero weight vector into a zero model
+                agg = tree_where(jnp.sum(act_mask) > 0, agg, params)
+            if stragglers:
+                stale = self._push_stale(stale, params)
+                return (agg, theta, vl_h, va_h, p_h, stale), None
+            return (agg, theta, vl_h, va_h, p_h), None
 
+        if stragglers:
+            (params, theta, vl_h, va_h, p_h, stale), _ = lax.scan(
+                mb_body, (state.params, state.theta, state.val_loss_h,
+                          state.val_acc_h, state.partner_h, state.stale),
+                jnp.arange(cfg.minibatch_count))
+            return state._replace(params=params, theta=theta, val_loss_h=vl_h,
+                                  val_acc_h=va_h, partner_h=p_h, stale=stale)
         (params, theta, vl_h, va_h, p_h), _ = lax.scan(
             mb_body, (state.params, state.theta, state.val_loss_h,
                       state.val_acc_h, state.partner_h),
@@ -629,9 +750,25 @@ class MplTrainer:
         P, n_max = stacked.x.shape[0], stacked.x.shape[1]
         ids, active, pids, flat_x, flat_y, slot_sizes, perms = \
             self._slot_binding(stacked, active_ids, rng)
+        # partner-level faults: slot activity = binding activity x the
+        # partner's dropout schedule (gathered by bound partner id, so a
+        # slot bound to a dropped partner behaves exactly like a padding
+        # slot from its drop epoch on: zero gradients, zero aggregation
+        # weight, survivors renormalized). Stragglers select their pass's
+        # start params from the TrainState's rolling stale buffer.
+        faulted = self._partner_faulted
+        act_mask = active * jnp.take(self._drop_active(e, P), pids) \
+            if faulted else active
+        stragglers = faulted and bool(cfg.partner_straggler_delays)
+        if stragglers:
+            delay_arr = jnp.asarray(cfg.partner_straggler_delays, jnp.int32)
+            D = max(cfg.partner_straggler_delays)
 
         def mb_body(carry, mb_i):
-            params, vl_h, va_h, p_h = carry
+            if stragglers:
+                params, vl_h, va_h, p_h, stale = carry
+            else:
+                params, vl_h, va_h, p_h = carry
             vl, va = self._maybe_val_eval(params, val, mb_i, es_col=0)
             vl_h = vl_h.at[e, mb_i].set(vl)
             va_h = va_h.at[e, mb_i].set(va)
@@ -640,12 +777,21 @@ class MplTrainer:
 
             def one(pid, act, perm_p, size_p):
                 r = jax.random.fold_in(rng_mb, pid)
+                if stragglers:
+                    d = jnp.take(delay_arr, pid)
+                    start = jax.tree_util.tree_map(
+                        lambda g, st: jnp.where(
+                            d == 0, g,
+                            jnp.take(st, jnp.clip(d - 1, 0, D - 1), axis=0)),
+                        params, stale)
+                else:
+                    start = params
                 p, _, ls, ac = self._partner_pass(
-                    params, flat_x, flat_y, perm_p, size_p, act, mb_i, r,
+                    start, flat_x, flat_y, perm_p, size_p, act, mb_i, r,
                     row_offset=pid * n_max, n_max=n_max)
                 return p, ls, ac
 
-            new_params, losses, accs = jax.vmap(one)(pids, active, perms,
+            new_params, losses, accs = jax.vmap(one)(pids, act_mask, perms,
                                                      slot_sizes)
 
             need_pval = cfg.record_partner_val or cfg.aggregator == "local-score"
@@ -660,11 +806,24 @@ class MplTrainer:
             p_h = p_h.at[:, scatter_rows, e, mb_i].set(
                 jnp.stack([losses, accs, pvl, pva]), mode="drop")
 
-            w = aggregation_weights(cfg.aggregator, active, slot_sizes,
+            w = aggregation_weights(cfg.aggregator, act_mask, slot_sizes,
                                     jnp.nan_to_num(pva))
-            params = aggregate(new_params, w)
-            return (params, vl_h, va_h, p_h), None
+            agg = aggregate(new_params, w)
+            if faulted:
+                # zero survivors this round: keep the global params
+                agg = tree_where(jnp.sum(act_mask) > 0, agg, params)
+            if stragglers:
+                stale = self._push_stale(stale, params)
+                return (agg, vl_h, va_h, p_h, stale), None
+            return (agg, vl_h, va_h, p_h), None
 
+        if stragglers:
+            (params, vl_h, va_h, p_h, stale), _ = lax.scan(
+                mb_body, (state.params, state.val_loss_h, state.val_acc_h,
+                          state.partner_h, state.stale),
+                jnp.arange(cfg.minibatch_count))
+            return state._replace(params=params, val_loss_h=vl_h,
+                                  val_acc_h=va_h, partner_h=p_h, stale=stale)
         (params, vl_h, va_h, p_h), _ = lax.scan(
             mb_body, (state.params, state.val_loss_h, state.val_acc_h,
                       state.partner_h),
@@ -883,6 +1042,17 @@ class MplTrainer:
         (params, opt_state, sums), _ = lax.scan(
             step, (state.params, state.opt_state, (0.0, 0.0, 0.0)),
             jnp.arange(steps))
+        if cfg.partner_drop_epochs is not None:
+            # partner-level dropout: from the partner's drop epoch on, its
+            # solo training simply stops — params AND optimizer state are
+            # frozen (the persistent Adam state would otherwise keep
+            # coasting on momentum with zero gradients). The epoch's v-eval
+            # below then scores the pre-drop model, every epoch after.
+            drop_p = jnp.take(jnp.asarray(cfg.partner_drop_epochs, jnp.int32),
+                              p)
+            act_e = jnp.where(drop_p == 0, True, e + 1 < drop_p)
+            params = tree_where(act_e, params, state.params)
+            opt_state = tree_where(act_e, opt_state, state.opt_state)
         if cfg.record_val_history or cfg.is_early_stopping:
             vl, va = self.evaluate(params, val)
         else:

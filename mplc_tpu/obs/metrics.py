@@ -22,6 +22,14 @@ Metric names used by the instrumented paths:
                                                slots-or-P: slot execution
                                                runs <= slot_count where the
                                                masked path runs P)
+    engine.batches                    counter  device batches harvested —
+                                               the dispatch-count view a
+                                               seed-ensemble sweep must
+                                               grow SUB-linearly in K
+                                               (replica rows pack into the
+                                               padding a single-seed sweep
+                                               wastes; asserted in
+                                               tests/test_partner_faults)
     engine.pad_waste_fraction         histogram per-batch padding fraction
     engine.device_mem_high_water_bytes gauge   peak bytes (memory_stats)
     engine.retries                    counter  transient-failure batch

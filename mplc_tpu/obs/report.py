@@ -26,7 +26,11 @@ quantities every perf PR needs as a measured before/after:
     (engine.retry events), OOM cap halvings and the CPU-path flip
     (engine.degrade), batches/coalitions that ran on the degraded CPU
     rung, and injected-fault counts (engine.fault) — so every recorded
-    number says whether it was earned on a clean or a degraded run.
+    number says whether it was earned on a clean or a degraded run;
+  - a trust row (seed-ensemble sweeps only): per-partner Shapley
+    confidence intervals and the Kendall-tau rank-stability score from
+    the `contrib.trust` event — so a reported ranking says how much the
+    seeds agree on it.
 
 The report is derived from SPANS of the collected region only, so callers
 get a clean per-run view without resetting the process-global metrics
@@ -67,6 +71,7 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     cap_halvings = cpu_fallbacks = 0
     cpu_batches = cpu_coalitions = 0
     faults_injected = 0
+    trust = None
 
     for rec in records:
         name = rec.get("name")
@@ -118,6 +123,10 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
                 cpu_fallbacks += 1
         elif name == "engine.fault":
             faults_injected += 1
+        elif name == "contrib.trust":
+            # one trust row per sweep; the last event wins (a re-run of
+            # the estimator within one collected region supersedes)
+            trust = dict(a)
         elif name == "contributivity":
             estimators.append({"method": a.get("method", "?"), "seconds": dur})
         elif name == "mpl.fit":
@@ -195,6 +204,8 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
         "compiles": compiles,
         "estimators": estimators,
     }
+    if trust is not None:
+        report["trust"] = trust
     if fits:
         report["fits"] = fits
     if metrics_snapshot is not None:
@@ -235,6 +246,23 @@ def format_report(report: dict) -> str:
             line += f"  cpu_coalitions={r['cpu_coalitions']}"
         if r.get("faults_injected"):
             line += f"  faults_injected={r['faults_injected']}"
+        lines.append(line)
+    t = report.get("trust")
+    if t is not None:
+        # seed-ensemble sweeps only: the answer-trust view — how wide the
+        # per-partner CIs are and how stable the ranking is across seeds
+        line = (f"  trust       ensemble={t.get('ensemble', '?')}  "
+                f"kendall_tau="
+                + (f"{t['kendall_tau']:.3f}"
+                   if t.get("kendall_tau") is not None else "n/a"))
+        mean = t.get("mean") or []
+        lo = t.get("ci_low") or []
+        hi = t.get("ci_high") or []
+        if mean and len(lo) == len(mean) and len(hi) == len(mean):
+            pct = int(round(100 * t.get("alpha", 0.95)))
+            cells = [f"p{i}: {m:.3f}±{(h - l) / 2:.3f}"
+                     for i, (m, l, h) in enumerate(zip(mean, lo, hi))]
+            line += f"  ci{pct}=[" + ", ".join(cells) + "]"
         lines.append(line)
     c = report.get("compute") or {}
     if c.get("train_samples"):

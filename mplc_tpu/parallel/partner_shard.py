@@ -56,10 +56,15 @@ def train_state_specs(axis: str, lflip: bool = False) -> TrainState:
     r = P()
     theta = P(axis) if lflip else P()
     theta_h = P(None, axis) if lflip else P()
+    # stale: the straggler-fault params buffer — always the empty pytree
+    # here (partner faults and 2-D partner sharding are mutually
+    # exclusive, TrainConfig.__post_init__), so the spec is a no-leaf
+    # placeholder like the non-lflip theta.
     return TrainState(params=r, opt_state=r, theta=theta,
                       theta_h=theta_h, epoch=r, done=r,
                       nb_epochs_done=r, best_val_loss=r, es_wait=r,
-                      val_loss_h=r, val_acc_h=r, partner_h=P(None, axis))
+                      val_loss_h=r, val_acc_h=r, partner_h=P(None, axis),
+                      stale=r)
 
 
 def stacked_specs(axis: str) -> StackedPartners:

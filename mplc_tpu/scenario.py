@@ -142,6 +142,24 @@ class Scenario:
             self.corrupted_datasets = corrupted_datasets
         else:
             self.corrupted_datasets = ["not_corrupted"] * self.partners_count
+        # Validate the corruption specs AT CONSTRUCTION against the
+        # vocabulary (data/partner.py CORRUPTION_KINDS): the reference —
+        # and this framework until now — let unknown names flow through to
+        # a debug log at corruption time, so a typo'd spec silently ran an
+        # UNCORRUPTED partner through a robustness experiment.
+        from .data.partner import CORRUPTION_KINDS
+        if len(self.corrupted_datasets) != self.partners_count:
+            raise ValueError(
+                f"corrupted_datasets has {len(self.corrupted_datasets)} "
+                f"entries for {self.partners_count} partners — one spec "
+                "per partner")
+        for idx, spec in enumerate(self.corrupted_datasets):
+            kind = spec[0] if isinstance(spec, (list, tuple)) else spec
+            if kind not in CORRUPTION_KINDS:
+                raise ValueError(
+                    f"corrupted_datasets[{idx}] = {kind!r} is not a valid "
+                    "corruption; valid names: "
+                    f"{', '.join(CORRUPTION_KINDS)}")
 
         # -- learning approach ------------------------------------------
         self.mpl = None
@@ -191,6 +209,10 @@ class Scenario:
         # set by the CharacteristicEngine once it picks its execution mode
         # (exact / pow2 slot bucketing, or the masked path)
         self.slot_bucketing = None
+        # set by data_corruption(): lets the engine warn when a partner
+        # fault plan carries data-plane (noisy/glabel) entries but the
+        # corruption step never ran (direct-engine callers)
+        self._data_faults_applied = False
 
         # -- contributivity methods -------------------------------------
         self.contributivity_list: list[Contributivity] = []
@@ -280,7 +302,11 @@ class Scenario:
                             constants.MAX_BATCH_SIZE)
 
     def data_corruption(self):
-        """Reference scenario.py:726-786 dispatch."""
+        """Reference scenario.py:726-786 dispatch, extended with the
+        feature-noise ('noisy', parameter = sigma) and global-label-flip
+        ('glabel', parameter = fraction) families, plus the data-plane
+        entries of the partner fault plan (MPLC_TPU_PARTNER_FAULT_PLAN
+        noisy/glabel entries — same seeded operators, env-driven)."""
         for partner_index, partner in enumerate(self.partners_list):
             spec = self.corrupted_datasets[partner_index]
             if isinstance(spec, (list, tuple)):
@@ -295,10 +321,32 @@ class Scenario:
                 partner.permute_labels(proportion)
             elif kind == "random":
                 partner.random_labels(proportion)
+            elif kind == "noisy":
+                # the spec parameter is the noise sigma, not a proportion
+                partner.noisy_features(0.1 if not isinstance(spec, (list, tuple))
+                                       else proportion)
+            elif kind == "glabel":
+                partner.flip_to_global_label(proportion)
             elif kind == "not_corrupted":
                 pass
-            else:
-                logger.debug("Unexpected label of corruption, no corruption performed!")
+            else:  # unreachable: validated at construction
+                raise ValueError(f"unknown corruption {kind!r}")
+        # partner-fault-plan data faults ride the same seeded operators —
+        # a plan can corrupt a partner without editing the scenario config.
+        # The parsed plan is stashed on the scenario so the engine's
+        # fingerprint/trainer faults derive from the SAME parse that
+        # corrupted the data (one env read per run, one clip warning).
+        from . import faults
+        plan = faults.clip_partner_plan(faults.partner_fault_plan_from_env(),
+                                        self.partners_count)
+        self._partner_fault_plan = plan
+        for pid, specs in faults.data_fault_specs(plan).items():
+            for kind, value in specs:
+                if kind == "noisy":
+                    self.partners_list[pid].noisy_features(value)
+                else:
+                    self.partners_list[pid].flip_to_global_label(value)
+        self._data_faults_applied = True
 
     def plot_data_distribution(self):
         import matplotlib

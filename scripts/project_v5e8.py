@@ -132,39 +132,43 @@ def parse_trace_split(path):
     return split
 
 
+def _telemetry_row(path, key):
+    """One row of a bench telemetry sidecar's report — the shared loader
+    behind every load_telemetry_* accessor. Rows absent from older report
+    schemas (or from runs that don't produce them) load as {} rather than
+    failing, so old perf artifacts keep working."""
+    import json
+    with open(path) as f:
+        rec = json.load(f)
+    return dict(rec.get("report", {}).get(key, {}) or {})
+
+
 def load_telemetry_split(path):
     """The wall-clock split from a bench telemetry sidecar
     (perf/telemetry_config<N>.json). Pre-prep-span sidecars (older report
     schema) load with prep_s = 0 rather than failing."""
-    import json
-    with open(path) as f:
-        rec = json.load(f)
-    w = dict(rec.get("report", {}).get("wallclock", {}))
+    w = _telemetry_row(path, "wallclock")
     w.setdefault("prep_s", 0.0)
     return w
 
 
 def load_telemetry_compute(path):
-    """The compute/MFU-proxy row from a bench telemetry sidecar — the
-    measured intensity the projection's width-scaling assumptions rest on.
-    Pre-compute-row sidecars (older report schema) return {} rather than
-    failing."""
-    import json
-    with open(path) as f:
-        rec = json.load(f)
-    return dict(rec.get("report", {}).get("compute", {}) or {})
+    """The compute/MFU-proxy row — the measured intensity the
+    projection's width-scaling assumptions rest on."""
+    return _telemetry_row(path, "compute")
 
 
 def load_telemetry_resilience(path):
-    """The resilience row from a bench telemetry sidecar: retries, OOM cap
-    halvings, CPU-degraded batches. A projection fed by a degraded run's
-    numbers is projecting the DEGRADED schedule — the printout flags it.
-    Pre-resilience sidecars (older report schema) return {} rather than
-    failing."""
-    import json
-    with open(path) as f:
-        rec = json.load(f)
-    return dict(rec.get("report", {}).get("resilience", {}) or {})
+    """The resilience row: retries, OOM cap halvings, CPU-degraded
+    batches. A projection fed by a degraded run's numbers is projecting
+    the DEGRADED schedule — the printout flags it."""
+    return _telemetry_row(path, "resilience")
+
+
+def load_telemetry_trust(path):
+    """The seed-ensemble trust row (per-partner Shapley CIs + Kendall-tau
+    rank stability); single-seed runs have no row and load as {}."""
+    return _telemetry_row(path, "trust")
 
 
 def parse_batch_times(log_path):
@@ -374,6 +378,16 @@ def main():
                   "its batch times mix recovery overhead (and possibly the "
                   "CPU rung) into the device schedule; prefer a clean "
                   "sidecar for projection")
+        t = load_telemetry_trust(args.telemetry)
+        if t.get("ensemble"):
+            # seed-ensemble run: the sweep's answer-trust view (absent in
+            # single-seed sidecars and every pre-trust schema — both print
+            # nothing). A K-replica run's batch times cover K x rows per
+            # coalition, which the projection inherits as-is.
+            tau = t.get("kendall_tau")
+            print(f"measured trust: ensemble={t['ensemble']} kendall_tau="
+                  + (f"{tau:.3f}" if tau is not None else "n/a")
+                  + " — per-partner CIs in the sidecar's report.trust row")
         print()
 
     times = parse_batch_times(args.log)

@@ -87,20 +87,23 @@ def test_devices_deadline_returns_none_on_hang(monkeypatch):
 
 def test_fallback_env_strip_covers_workload_knobs():
     """The CPU fallback child must not inherit any workload-shaping knob;
-    keep _spawn_cpu_fallback's strip list superset-consistent with
-    _replay_cached_tpu_result's refusal list (ADVICE r5: the eval-chunk
-    knob was missing from both)."""
+    both the replay refusal and the env strip now iterate the ONE shared
+    bench._WORKLOAD_KNOBS list (ADVICE r5 caught the eval-chunk knob
+    missing from both hand-maintained copies; the shared list makes that
+    class of drift impossible)."""
     import inspect
-    src_replay = inspect.getsource(bench._replay_cached_tpu_result)
-    src_spawn = inspect.getsource(bench._spawn_cpu_fallback)
+    assert "_WORKLOAD_KNOBS" in inspect.getsource(
+        bench._replay_cached_tpu_result)
+    assert "_WORKLOAD_KNOBS" in inspect.getsource(bench._spawn_cpu_fallback)
     for knob in ("MPLC_TPU_EVAL_CHUNK", "BENCH_DTYPE",
                  "MPLC_TPU_BATCH_CAP_CEILING",
                  "MPLC_TPU_COALITIONS_PER_DEVICE", "MPLC_TPU_NO_SLOTS",
                  "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
                  "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
-                 "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SYNTH_SCALE"):
-        assert knob in src_replay, f"{knob} missing from replay refusal"
-        assert knob in src_spawn, f"{knob} missing from fallback env strip"
+                 "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SYNTH_SCALE",
+                 "MPLC_TPU_PARTNER_FAULT_PLAN", "MPLC_TPU_SEED_ENSEMBLE"):
+        assert knob in bench._WORKLOAD_KNOBS, \
+            f"{knob} missing from bench._WORKLOAD_KNOBS"
 
 
 def test_cpu_fallback_refuses_to_recurse(monkeypatch):
@@ -169,7 +172,8 @@ _ALL_REPLAY_KNOBS = (
     "MPLC_TPU_BATCH_CAP_CEILING", "MPLC_TPU_NO_SLOTS",
     "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_COALITIONS_PER_DEVICE",
     "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_PIPELINE_BATCHES",
-    "MPLC_TPU_STEP_WIDTH_MULT")
+    "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_PARTNER_FAULT_PLAN",
+    "MPLC_TPU_SEED_ENSEMBLE")
 
 
 def _clean_replay_env(monkeypatch):

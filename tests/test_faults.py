@@ -35,7 +35,8 @@ SUBSETS = powerset_order(5)
 # multis = width-3 bucket (sizes 2+3, 20 coalitions -> batches 2-4) then
 # the width-5 bucket (sizes 4+5, 6 coalitions -> batch 5)
 _FAULT_KNOBS = ("MPLC_TPU_FAULT_PLAN", "MPLC_TPU_MAX_RETRIES",
-                "MPLC_TPU_MAX_CAP_HALVINGS", "MPLC_TPU_PIPELINE_BATCHES")
+                "MPLC_TPU_MAX_CAP_HALVINGS", "MPLC_TPU_PIPELINE_BATCHES",
+                "MPLC_TPU_PARTNER_FAULT_PLAN", "MPLC_TPU_SEED_ENSEMBLE")
 
 
 @pytest.fixture(autouse=True)

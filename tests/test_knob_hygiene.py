@@ -60,21 +60,43 @@ def test_registry_classes_are_valid():
 
 
 def test_workload_knobs_refuse_replay_and_strip_from_fallback():
-    """Every workload-shaping knob must appear in bench's cached-replay
-    refusal source AND the CPU-fallback env-strip source: a cached TPU
-    number is a different workload under any non-default value, and the
-    reduced CPU child must not inherit parent tuning."""
+    """Every workload-shaping knob must be covered by bench's cached-
+    replay refusal AND the CPU-fallback env-strip: a cached TPU number is
+    a different workload under any non-default value, and the reduced CPU
+    child must not inherit parent tuning. Coverage is via the shared
+    bench._WORKLOAD_KNOBS list (both functions must reference it) or a
+    knob-specific special case in the function source (SYNTH_NOISE)."""
     src_replay = inspect.getsource(bench._replay_cached_tpu_result)
     src_spawn = inspect.getsource(bench._spawn_cpu_fallback)
+    assert "_WORKLOAD_KNOBS" in src_replay, (
+        "bench._replay_cached_tpu_result no longer iterates the shared "
+        "_WORKLOAD_KNOBS list")
+    assert "_WORKLOAD_KNOBS" in src_spawn, (
+        "bench._spawn_cpu_fallback no longer iterates the shared "
+        "_WORKLOAD_KNOBS list")
     for knob, klass in sorted(constants.ENV_KNOBS.items()):
         if klass != "workload":
             continue
-        assert knob in src_replay, (
-            f"workload knob {knob} missing from "
-            "bench._replay_cached_tpu_result's refusal logic")
-        assert knob in src_spawn, (
-            f"workload knob {knob} missing from "
-            "bench._spawn_cpu_fallback's env-strip list")
+        assert knob in bench._WORKLOAD_KNOBS or knob in src_replay, (
+            f"workload knob {knob} missing from bench._WORKLOAD_KNOBS "
+            "and not special-cased in _replay_cached_tpu_result")
+        assert knob in bench._WORKLOAD_KNOBS or knob in src_spawn, (
+            f"workload knob {knob} missing from bench._WORKLOAD_KNOBS "
+            "and not special-cased in _spawn_cpu_fallback")
+
+
+def test_workload_knobs_are_documented():
+    """Docs-drift check: every workload-shaping knob in ENV_KNOBS must be
+    mentioned in documentation.md — a knob the docs never name is a knob
+    operators discover by reading source (or never), and the doc's knob
+    sections silently rot as PRs add knobs."""
+    doc = (REPO / "mplc_tpu" / "doc" / "documentation.md").read_text()
+    missing = [k for k, klass in sorted(constants.ENV_KNOBS.items())
+               if klass == "workload" and k not in doc]
+    assert not missing, (
+        f"workload knobs {missing} are registered in constants.ENV_KNOBS "
+        "but never mentioned in mplc_tpu/doc/documentation.md — document "
+        "them (what they shape, defaults, deviation semantics)")
 
 
 def test_sidecar_knobs_are_stripped_from_fallback():

@@ -1,0 +1,423 @@
+"""Partner-level fault model + trust-calibrated Shapley (ISSUE 6).
+
+Three contracts under test:
+
+1. **Determinism & exclusion equality.** Partner-fault runs are fully
+   deterministic (same plan twice => bit-identical v(S) and Shapley
+   values), and a partner dropped from epoch 1 is an exact null player:
+   every faulty v(S) equals the fault-free v(S minus the partner) BIT
+   FOR BIT — trainer-level masking + FedAvg renormalization reproduce
+   exclusion exactly (rng canonicalized over the effective membership).
+
+2. **Corruption vocabulary.** 'noisy'/'glabel' extend corrupted_datasets
+   with seeded generators; unknown names now raise at Scenario
+   construction with the valid list; the fault plan's data-plane entries
+   ride the same operators.
+
+3. **Seed-ensemble trust.** seed_ensemble=K packs K replicas as extra
+   slot-batch rows (dispatch count grows SUB-linearly in K — asserted on
+   the engine.batches counter), replica 0 is bit-identical to a K=1 run,
+   and the Shapley path grows per-partner CIs + a Kendall-tau
+   rank-stability score rendered as the report's `trust` row.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mplc_tpu import faults
+from mplc_tpu.contrib.contributivity import Contributivity
+from mplc_tpu.contrib.engine import CharacteristicEngine
+from mplc_tpu.contrib.shapley import (confidence_intervals, kendall_tau,
+                                      powerset_order, rank_stability,
+                                      shapley_from_characteristic,
+                                      shapley_sample_matrix, trust_summary)
+from mplc_tpu.obs import metrics, report, trace
+
+
+def scenario(n=4, seed=9, **kw):
+    from helpers import build_scenario
+    amounts = {3: [0.2, 0.3, 0.5], 4: [0.1, 0.2, 0.3, 0.4]}[n]
+    params = dict(partners_count=n, amounts_per_partner=amounts,
+                  dataset_name="titanic", epoch_count=2,
+                  gradient_updates_per_pass_count=2, seed=seed)
+    params.update(kw)
+    return build_scenario(**params)
+
+
+SUBSETS = powerset_order(4)
+
+_KNOBS = ("MPLC_TPU_PARTNER_FAULT_PLAN", "MPLC_TPU_SEED_ENSEMBLE",
+          "MPLC_TPU_FAULT_PLAN")
+
+
+@pytest.fixture(autouse=True)
+def _env(monkeypatch):
+    for k in _KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+_REF = {}
+
+
+def reference():
+    """Fault-free single-seed v(S) for `scenario()`, once per process."""
+    assert "MPLC_TPU_PARTNER_FAULT_PLAN" not in os.environ
+    if "vals" not in _REF:
+        _REF["vals"] = CharacteristicEngine(scenario()).evaluate(SUBSETS)
+    return _REF["vals"]
+
+
+# -- plan grammar ------------------------------------------------------------
+
+def test_partner_plan_grammar_parses_all_kinds():
+    plan = faults.parse_partner_fault_plan(
+        "dropout@p2:epoch3, straggler@p0:delay2,noisy@p1:sigma0.1,"
+        "glabel@p3:frac0.5,straggler@p2:delay1")
+    assert plan == {2: {"dropout": 3, "straggler": 1},
+                    0: {"straggler": 2},
+                    1: {"noisy": 0.1},
+                    3: {"glabel": 0.5}}
+    assert faults.parse_partner_fault_plan(None) == {}
+    assert faults.parse_partner_fault_plan("") == {}
+
+
+def test_partner_plan_malformed_entries_warn_and_are_skipped():
+    for bad in ("dropout@p2:delay3",        # kind/param mismatch
+                "dropout@p2:epoch0",        # ordinal < 1
+                "glabel@p1:frac1.5",        # out of [0, 1]
+                "vanish@p1:epoch2",         # unknown kind
+                "dropout@2:epoch3",         # missing 'p'
+                "dropout@p2"):              # no param
+        with pytest.warns(UserWarning, match="malformed entry"):
+            assert faults.parse_partner_fault_plan(bad) == {}
+
+
+def test_partner_plan_duplicate_keeps_first_and_warns():
+    with pytest.warns(UserWarning, match="duplicate"):
+        plan = faults.parse_partner_fault_plan(
+            "dropout@p1:epoch2,dropout@p1:epoch5")
+    assert plan == {1: {"dropout": 2}}
+
+
+def test_partner_plan_views():
+    plan = faults.parse_partner_fault_plan(
+        "dropout@p0:epoch1,dropout@p2:epoch3,straggler@p1:delay2,"
+        "noisy@p1:sigma0.2,glabel@p3:frac1.0")
+    drops, delays = faults.trainer_fault_arrays(plan, 4)
+    assert drops == (1, 0, 3, 0)
+    assert delays == (0, 2, 0, 0)
+    assert faults.forever_dropped(plan) == frozenset({0})
+    assert faults.data_fault_specs(plan) == {1: [("noisy", 0.2)],
+                                             3: [("glabel", 1.0)]}
+    # no trainer faults at all -> both None (fault-free compiled programs)
+    assert faults.trainer_fault_arrays(
+        {1: {"noisy": 0.2}}, 4) == (None, None)
+    # out-of-range ids clip with a warning
+    with pytest.warns(UserWarning, match="ignoring entries"):
+        clipped = faults.clip_partner_plan(plan, 2)
+    assert set(clipped) == {0, 1}
+    # canonical repr is sorted and stable
+    assert faults.normalized_plan_repr(plan) == \
+        "dropout@p0:1,noisy@p1:0.2,straggler@p1:2,dropout@p2:3,glabel@p3:1.0"
+
+
+# -- dropout: determinism + exclusion equality (satellite 3) -----------------
+
+def test_forever_dropout_equals_partner_excluded_runs(monkeypatch):
+    """dropout@pK:epoch1: every faulty v(S) must BIT-IDENTICALLY equal
+    the fault-free v(S \\ {K}) — the trainer-level mask + FedAvg weight
+    renormalization reproduce exclusion exactly, and a coalition reduced
+    to nothing takes v(empty) = 0."""
+    ref = dict(zip(SUBSETS, reference()))
+    monkeypatch.setenv("MPLC_TPU_PARTNER_FAULT_PLAN", "dropout@p2:epoch1")
+    eng = CharacteristicEngine(scenario())
+    vals = dict(zip(SUBSETS, eng.evaluate(SUBSETS)))
+    for s in SUBSETS:
+        eff = tuple(i for i in s if i != 2)
+        expected = ref[eff] if eff else 0.0
+        assert vals[s] == expected, (s, vals[s], expected)
+    assert eng.first_charac_fct_calls_count == len(SUBSETS)
+
+
+def test_forever_dropout_shapley_matches_restricted_game(monkeypatch):
+    """The dropped partner is an exact null player: its Shapley value is
+    0 and the survivors' values equal the (P-1)-partner restricted
+    game's (the carrier property, on measured v(S) tables)."""
+    ref = dict(zip(SUBSETS, reference()))
+    monkeypatch.setenv("MPLC_TPU_PARTNER_FAULT_PLAN", "dropout@p2:epoch1")
+    vals = dict(zip(SUBSETS, CharacteristicEngine(scenario()).evaluate(SUBSETS)))
+    sv_f = shapley_from_characteristic(4, vals)
+    assert sv_f[2] == 0.0
+    # restricted 3-player game over partners {0, 1, 3} (remapped 0/1/2)
+    remap = {0: 0, 1: 1, 3: 2}
+    restricted = {tuple(sorted(remap[i] for i in s)): v
+                  for s, v in ref.items() if 2 not in s}
+    sv_r = shapley_from_characteristic(3, restricted)
+    np.testing.assert_allclose(sv_f[[0, 1, 3]], sv_r, atol=1e-12)
+
+
+def test_partner_fault_runs_are_deterministic(monkeypatch):
+    """Same plan twice => bit-identical v(S) AND Shapley values (the
+    satellite's determinism contract), for a mid-run dropout + straggler
+    combination plan."""
+    ref = reference()
+    monkeypatch.setenv("MPLC_TPU_PARTNER_FAULT_PLAN",
+                       "dropout@p1:epoch2,straggler@p0:delay2")
+    a = CharacteristicEngine(scenario()).evaluate(SUBSETS)
+    b = CharacteristicEngine(scenario()).evaluate(SUBSETS)
+    np.testing.assert_array_equal(a, b)
+    sv_a = shapley_from_characteristic(4, dict(zip(SUBSETS, a)))
+    sv_b = shapley_from_characteristic(4, dict(zip(SUBSETS, b)))
+    np.testing.assert_array_equal(sv_a, sv_b)
+    # and the faults actually bit: the faulty game differs from clean
+    assert not np.array_equal(a, ref)
+
+
+def test_midrun_dropout_and_straggler_leave_unaffected_coalitions_alone(
+        monkeypatch):
+    """Faults on partner K must not perturb coalitions that exclude K:
+    those subsets' v(S) stay bit-identical to the fault-free run's (the
+    fault arrays ride the config, but only bound slots read them)."""
+    ref = dict(zip(SUBSETS, reference()))
+    monkeypatch.setenv("MPLC_TPU_PARTNER_FAULT_PLAN",
+                       "dropout@p3:epoch2,straggler@p3:delay1")
+    vals = dict(zip(SUBSETS, CharacteristicEngine(scenario()).evaluate(SUBSETS)))
+    without_3 = [s for s in SUBSETS if 3 not in s]
+    for s in without_3:
+        assert vals[s] == ref[s], s
+    # ...and coalitions WITH the faulted partner did change
+    assert any(vals[s] != ref[s] for s in SUBSETS if 3 in s)
+
+
+def test_all_members_dropped_midrun_keeps_finite_values(monkeypatch):
+    """A round with zero survivors must keep the global params (not
+    aggregate an all-zero weight vector into a zero model): values stay
+    finite and deterministic."""
+    monkeypatch.setenv("MPLC_TPU_PARTNER_FAULT_PLAN",
+                       "dropout@p0:epoch2,dropout@p1:epoch2")
+    eng = CharacteristicEngine(scenario())
+    vals = eng.evaluate([(0, 1), (0,), (1,)])
+    assert np.all(np.isfinite(vals))
+    vals2 = CharacteristicEngine(scenario()).evaluate([(0, 1), (0,), (1,)])
+    np.testing.assert_array_equal(vals, vals2)
+
+
+def test_trainer_faults_require_fedavg(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_PARTNER_FAULT_PLAN", "dropout@p0:epoch2")
+    with pytest.raises(ValueError, match="fedavg"):
+        CharacteristicEngine(
+            scenario(multi_partner_learning_approach="seq-pure"))
+
+
+# -- corruption vocabulary (satellite 1) -------------------------------------
+
+def test_unknown_corruption_raises_with_valid_names():
+    with pytest.raises(ValueError, match="glabel"):
+        scenario(corrupted_datasets=["not_corrupted", "bogus",
+                                     "not_corrupted", "not_corrupted"])
+    with pytest.raises(ValueError, match="one spec per partner"):
+        scenario(corrupted_datasets=["not_corrupted"] * 3)
+
+
+def test_noisy_and_glabel_corruptions_are_seeded():
+    clean = scenario(seed=5)
+    sc = scenario(seed=5, corrupted_datasets=[("noisy", 0.5),
+                                              ("glabel", 1.0),
+                                              "not_corrupted",
+                                              "not_corrupted"])
+    sc2 = scenario(seed=5, corrupted_datasets=[("noisy", 0.5),
+                                               ("glabel", 1.0),
+                                               "not_corrupted",
+                                               "not_corrupted"])
+    # noisy perturbs features, deterministically per seed
+    assert not np.array_equal(sc.partners_list[0].x_train,
+                              clean.partners_list[0].x_train)
+    np.testing.assert_array_equal(sc.partners_list[0].x_train,
+                                  sc2.partners_list[0].x_train)
+    # glabel collapses the partner's labels onto ONE target class
+    assert len(np.unique(np.asarray(sc.partners_list[1].y_train))) == 1
+    # untouched partners stay untouched
+    np.testing.assert_array_equal(sc.partners_list[2].x_train,
+                                  clean.partners_list[2].x_train)
+
+
+def test_plan_data_faults_apply_at_corruption_time(monkeypatch):
+    clean = scenario(seed=5)
+    monkeypatch.setenv("MPLC_TPU_PARTNER_FAULT_PLAN", "noisy@p1:sigma0.5")
+    sc = scenario(seed=5)
+    assert not np.array_equal(sc.partners_list[1].x_train,
+                              clean.partners_list[1].x_train)
+    np.testing.assert_array_equal(sc.partners_list[0].x_train,
+                                  clean.partners_list[0].x_train)
+
+
+# -- seed-ensemble sweeps ----------------------------------------------------
+
+def test_ensemble_replica0_is_bit_identical_to_single_seed():
+    ref = reference()
+    eng = CharacteristicEngine(scenario(), seed_ensemble=3)
+    vals = eng.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    # every subset carries a full replica row, replica 0 = the point value
+    assert set(eng.charac_fct_samples) == set(SUBSETS)
+    for s in SUBSETS:
+        arr = eng.charac_fct_samples[s]
+        assert arr.shape == (3,) and not np.isnan(arr).any()
+        assert arr[0] == eng.charac_fct_values[s]
+    # the replicas are genuinely different games (different base seeds)
+    assert any(len(set(eng.charac_fct_samples[s])) > 1 for s in SUBSETS)
+    assert eng.first_charac_fct_calls_count == len(SUBSETS)
+
+
+def test_ensemble_batches_grow_sublinearly(monkeypatch):
+    """K replicas ride the SAME buckets as extra rows — the acceptance
+    criterion's engine.batch dispatch count must grow sub-linearly in K
+    (asserted via the obs counter, as the issue specifies)."""
+    CharacteristicEngine(scenario()).evaluate(SUBSETS)
+    b1 = metrics.snapshot()["counters"]["engine.batches"]
+    metrics.reset()
+    CharacteristicEngine(scenario(), seed_ensemble=4).evaluate(SUBSETS)
+    b4 = metrics.snapshot()["counters"]["engine.batches"]
+    assert b1 > 0 and b4 < 4 * b1, (b1, b4)
+
+
+def test_ensemble_env_knob_drives_compute_sv_trust(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_SEED_ENSEMBLE", "3")
+    sc = scenario()
+    with trace.collect() as recs:
+        c = Contributivity(sc)
+        c.compute_contributivity("Shapley values")
+    assert c.trust is not None
+    assert c.trust["ensemble"] == 3
+    assert -1.0 <= c.trust["kendall_tau"] <= 1.0
+    assert len(c.trust["ci_low"]) == 4
+    # the replica spread is the honest scores_std
+    assert (np.asarray(c.scores_std) >= 0).all()
+    assert np.any(np.asarray(c.trust["std"]) > 0)
+    # CI brackets the mean
+    assert np.all(np.asarray(c.trust["ci_low"])
+                  <= np.asarray(c.trust["mean"]))
+    assert np.all(np.asarray(c.trust["mean"])
+                  <= np.asarray(c.trust["ci_high"]))
+    # the trust event reached the collected trace -> report + rendering
+    rep = report.sweep_report(recs)
+    assert rep["trust"]["ensemble"] == 3
+    assert "trust" in report.format_report(rep)
+
+
+def test_ensemble_oom_recovery_does_not_double_count(monkeypatch):
+    """A subset whose replica rows straddle two batches re-runs ALL its
+    replicas when the second batch's harvest OOMs — the recovery must not
+    re-store the already-stored replica-0 point estimate (that would
+    inflate first_charac_fct_calls_count past the coalition count and
+    trip bench's post-sweep assert)."""
+    ref = reference()
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    # K=3 on 4 singles = 12 jobs at width 8: subset 2's replicas straddle
+    # batches 1 and 2; the harvest-2 OOM forces the redo of subsets 2+3
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "oom@harvest2")
+    eng = CharacteristicEngine(scenario(), seed_ensemble=3)
+    vals = eng.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    assert eng.first_charac_fct_calls_count == len(SUBSETS)
+    for s in SUBSETS:
+        assert not np.isnan(eng.charac_fct_samples[s]).any(), s
+
+
+def test_ensemble_composes_with_forever_dropout(monkeypatch):
+    """The two tentpole halves compose: under a seed ensemble EVERY
+    replica honors the dropout-exclusion equality (rng canonicalization
+    is per-row, so replica j of S u {k} trains replica j of S)."""
+    monkeypatch.setenv("MPLC_TPU_PARTNER_FAULT_PLAN", "dropout@p2:epoch1")
+    eng = CharacteristicEngine(scenario(), seed_ensemble=2)
+    eng.evaluate(SUBSETS)
+    for s in SUBSETS:
+        eff = tuple(i for i in s if i != 2)
+        if not eff:
+            np.testing.assert_array_equal(eng.charac_fct_samples[s],
+                                          np.zeros(2))
+        elif eff != s:
+            np.testing.assert_array_equal(eng.charac_fct_samples[s],
+                                          eng.charac_fct_samples[eff])
+
+
+def test_ensemble_rejected_in_2d_mode(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "2")
+    with pytest.raises(ValueError, match="2-D"):
+        CharacteristicEngine(scenario(), seed_ensemble=2)
+
+
+def test_ensemble_cache_roundtrip_and_fingerprint(tmp_path, monkeypatch):
+    eng = CharacteristicEngine(scenario(), seed_ensemble=2)
+    eng.evaluate(SUBSETS)
+    path = tmp_path / "cache.json"
+    eng.save_cache(path)
+    resumed = CharacteristicEngine(scenario(), seed_ensemble=2)
+    resumed.load_cache(path)
+    assert resumed.charac_fct_values == eng.charac_fct_values
+    for s, arr in eng.charac_fct_samples.items():
+        np.testing.assert_array_equal(resumed.charac_fct_samples[s], arr)
+    # a single-seed engine refuses the ensemble cache (different game
+    # description), and a partner-fault plan refuses a clean cache
+    with pytest.raises(ValueError, match="different scenario"):
+        CharacteristicEngine(scenario()).load_cache(path)
+    clean_path = tmp_path / "clean.json"
+    clean = CharacteristicEngine(scenario())
+    clean.evaluate(SUBSETS[:3])
+    clean.save_cache(clean_path)
+    monkeypatch.setenv("MPLC_TPU_PARTNER_FAULT_PLAN", "dropout@p1:epoch2")
+    with pytest.raises(ValueError, match="different scenario"):
+        CharacteristicEngine(scenario()).load_cache(clean_path)
+
+
+# -- trust math --------------------------------------------------------------
+
+def test_kendall_tau_and_rank_stability():
+    assert kendall_tau([1, 2, 3], [10, 20, 30]) == 1.0
+    assert kendall_tau([1, 2, 3], [3, 2, 1]) == -1.0
+    assert kendall_tau([5.0], [1.0]) == 1.0
+    samples = np.array([[0.1, 0.2, 0.3],
+                        [0.15, 0.25, 0.35],
+                        [0.1, 0.22, 0.31]])
+    assert rank_stability(samples) == 1.0          # all replicas agree
+    flipped = np.array([[0.1, 0.2, 0.3], [0.3, 0.2, 0.1]])
+    assert rank_stability(flipped) == -1.0
+    assert rank_stability(samples[:1]) == 1.0      # K = 1: trivially stable
+
+
+def test_confidence_intervals_and_sample_matrix():
+    n = 3
+    phi = np.array([0.1, 0.25, 0.65])
+    # additive game, replica j scaled by (1 + j/10): SV_j = phi * scale_j
+    samples = {}
+    for s in powerset_order(n):
+        samples[s] = np.array([sum(phi[i] for i in s) * (1 + j / 10)
+                               for j in range(4)])
+    sv = shapley_sample_matrix(n, samples)
+    assert sv.shape == (4, n)
+    for j in range(4):
+        np.testing.assert_allclose(sv[j], phi * (1 + j / 10), atol=1e-12)
+    mean, lo, hi = confidence_intervals(sv)
+    assert np.all(lo <= mean) and np.all(mean <= hi)
+    assert np.all(hi - lo > 0)                     # genuine spread
+    t = trust_summary(n, samples)
+    assert t["ensemble"] == 4 and t["kendall_tau"] == 1.0
+    np.testing.assert_allclose(t["mean"], mean)
+    # K = 1 degenerates to zero-width intervals
+    one = {s: arr[:1] for s, arr in samples.items()}
+    t1 = trust_summary(n, one)
+    assert t1["ci_low"] == t1["ci_high"] == t1["mean"]
+    with pytest.raises(ValueError, match="empty replica table"):
+        shapley_sample_matrix(n, {})
+
+
+def test_sweep_report_without_trust_row_still_formats():
+    rep = report.sweep_report([])
+    assert "trust" not in rep
+    assert "sweep report" in report.format_report(rep)

@@ -220,3 +220,25 @@ def test_telemetry_compute_row_loads_and_degrades(tmp_path):
     old.write_text(json.dumps({
         "metric": "m", "report": {"wallclock": {"evaluate_s": 290.0}}}))
     assert proj.load_telemetry_compute(str(old)) == {}
+
+
+def test_telemetry_trust_row_loads_and_degrades(tmp_path):
+    """load_telemetry_trust reads the seed-ensemble trust row from a
+    bench sidecar; single-seed and pre-trust-schema sidecars load as {}
+    (the projection prints nothing extra) instead of failing — same
+    compat contract as the resilience row."""
+    import json
+    new = tmp_path / "telemetry_config1.json"
+    new.write_text(json.dumps({
+        "metric": "m",
+        "report": {"wallclock": {"evaluate_s": 290.0},
+                   "trust": {"ensemble": 5, "kendall_tau": 0.87,
+                             "mean": [0.1, 0.2], "ci_low": [0.05, 0.15],
+                             "ci_high": [0.15, 0.25]}}}))
+    t = proj.load_telemetry_trust(str(new))
+    assert t["ensemble"] == 5
+    assert t["kendall_tau"] == 0.87
+    old = tmp_path / "telemetry_old.json"
+    old.write_text(json.dumps({
+        "metric": "m", "report": {"wallclock": {"evaluate_s": 290.0}}}))
+    assert proj.load_telemetry_trust(str(old)) == {}
