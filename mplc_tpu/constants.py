@@ -41,6 +41,12 @@ CONTRIBUTIVITY_METHODS = [
     "Federated SBS constant",
     "LFlip",
     "PVRL",
+    # Retrain-free family (this framework, beyond the reference registry):
+    # coalition models are RECONSTRUCTED from per-partner updates recorded
+    # during one grand-coalition training run (contrib/reconstruct.py), so
+    # v(S) costs one eval-only batch instead of a full retrain.
+    "GTG-Shapley",
+    "SVARM",
 ]
 
 # Dataset tags (reference: mplc/constants.py:46-52)
@@ -81,6 +87,25 @@ def _env_positive_int(name: str, default: int) -> int:
     except ValueError:
         import warnings
         warnings.warn(f"{name}={raw!r} is not a positive integer; "
+                      f"falling back to {default}", stacklevel=2)
+        return default
+    return value
+
+
+def _env_nonneg_int(name: str, default: int) -> int:
+    """Same warn+fallback contract as `_env_positive_int`, for integer
+    knobs where an explicit 0 is a documented value (e.g.
+    MPLC_TPU_SVARM_SAMPLES=0 meaning auto) and must not warn."""
+    raw = _os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = int(raw)
+        if value < 0:
+            raise ValueError(raw)
+    except ValueError:
+        import warnings
+        warnings.warn(f"{name}={raw!r} is not a non-negative integer; "
                       f"falling back to {default}", stacklevel=2)
         return default
     return value
@@ -159,6 +184,30 @@ RETRY_BACKOFF_CAP_SEC = 30.0  # bound on a single backoff sleep
 PARTNER_FAULT_PLAN_ENV = "MPLC_TPU_PARTNER_FAULT_PLAN"
 SEED_ENSEMBLE_ENV = "MPLC_TPU_SEED_ENSEMBLE"
 
+# Persistent XLA compilation cache (utils.enable_compile_cache_from_env):
+# when set, every compiled program — the slot-pipeline trainers, the
+# reconstruction eval programs, bench warm-up — is persisted to this
+# directory, so a service restart or a repeated sweep pays zero residual
+# compile (the first step of the ROADMAP "program bank" item; bench's
+# warm-up doubles as a cache prime and the telemetry sidecar records the
+# cache-hit provenance). Read wherever compilation is about to start
+# (bench.main, CharacteristicEngine construction); unset = JAX default
+# (no persistent cache, unless the caller configured one directly).
+COMPILE_CACHE_DIR_ENV = "MPLC_TPU_COMPILE_CACHE_DIR"
+
+# Retrain-free estimator knobs (contrib/contributivity.py GTG-Shapley /
+# SVARM, warn+fallback parses at method-call time):
+#   MPLC_TPU_GTG_TRUNCATION   within-round truncation threshold for
+#                             GTG-Shapley's permutation scan: once
+#                             |v(N) - v(prefix)| < threshold the
+#                             remaining positions of that permutation are
+#                             truncated (marginal ~ 0). Default 0.05.
+#   MPLC_TPU_SVARM_SAMPLES    SVARM's sampled-coalition budget after the
+#                             exact anchors + per-stratum warm-up;
+#                             0/unset = auto (max(4 n^2, 128)).
+GTG_TRUNCATION_ENV = "MPLC_TPU_GTG_TRUNCATION"
+SVARM_SAMPLES_ENV = "MPLC_TPU_SVARM_SAMPLES"
+
 # ---------------------------------------------------------------------------
 # Env-knob registry. EVERY `MPLC_TPU_*` env var the framework reads must be
 # registered here with its class — tests/test_knob_hygiene.py greps the
@@ -180,7 +229,14 @@ SEED_ENSEMBLE_ENV = "MPLC_TPU_SEED_ENSEMBLE"
 ENV_KNOBS = {
     "MPLC_TPU_BATCH_CAP_CEILING": "workload",
     "MPLC_TPU_COALITIONS_PER_DEVICE": "workload",
+    # workload, not sidecar: the cache changes what a measured run PAYS
+    # (residual compiles land inside the timed region), so a cached TPU
+    # number is not comparable to a cache-warmed run — and the CPU child
+    # configures its own cache dir
+    "MPLC_TPU_COMPILE_CACHE_DIR": "workload",
     "MPLC_TPU_EVAL_CHUNK": "workload",
+    "MPLC_TPU_GTG_TRUNCATION": "workload",
+    "MPLC_TPU_SVARM_SAMPLES": "workload",
     "MPLC_TPU_FAULT_PLAN": "workload",
     "MPLC_TPU_MAX_CAP_HALVINGS": "workload",
     "MPLC_TPU_MAX_RETRIES": "workload",

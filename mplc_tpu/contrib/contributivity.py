@@ -50,9 +50,10 @@ from ..mpl.engine import MplTrainer, TrainConfig
 from ..obs import trace as obs_trace
 from .engine import CharacteristicEngine
 from .sampling import (WithoutReplacementRanks, make_importance_sampler,
-                       randbelow, unrank_combination)
+                       randbelow, svarm_batch_draws, svarm_warmup_draws,
+                       unrank_combination)
 from .shapley import (powerset_order, shapley_from_characteristic,
-                      trust_summary)
+                      trust_from_replicas, trust_summary)
 
 logger = logging.getLogger("mplc_tpu")
 
@@ -232,20 +233,22 @@ class Contributivity:
     # 3/4. truncated MC (+ interpolated variant) — permutation wavefront
     # ------------------------------------------------------------------
 
-    def _tmc(self, sv_accuracy, alpha, truncation, interpolate, perm_batch=16):
-        name = "ITMCS" if interpolate else "TMC Shapley"
-        t0 = self._method_span(name)
-        n = self._n
-        v_all = float(self.engine.evaluate([tuple(range(n))])[0])
-        if n == 1:
-            self._finish(name, np.array([v_all]), np.array([0.0]), t0)
-            return
-        sizes = self._sizes()
+    def _truncated_permutation_sweep(self, n, v_all, eval_fn, values,
+                                     sv_accuracy, alpha, truncation,
+                                     interpolate, sizes, perm_batch,
+                                     min_iter=100):
+        """The truncated-permutation wavefront shared by TMCS/ITMCS (v
+        from retraining) and GTG-Shapley (v from reconstruction):
+        `perm_batch` permutations advance in lock-step, and at prefix
+        length j only the non-truncated permutations' prefixes are
+        evaluated — in one batch through `eval_fn` — preserving each
+        permutation's truncation rule exactly. `values` is the live memo
+        `eval_fn` fills. Returns (contributions [T, n], T)."""
         q = norm.ppf((1 - alpha) / 2, loc=0, scale=1)
         contributions = np.zeros((0, n))
         t = 0
         v_max = 0.0
-        while t < 100 or t < q ** 2 * v_max / sv_accuracy ** 2:
+        while t < min_iter or t < q ** 2 * v_max / sv_accuracy ** 2:
             k_round = perm_batch
             perms = [self._rng.permutation(n) for _ in range(k_round)]
             rows = np.zeros((k_round, n))
@@ -255,13 +258,13 @@ class Contributivity:
                 need = [k for k in range(k_round)
                         if abs(v_all - prefix_vals[k]) >= truncation]
                 if need:
-                    self.engine.evaluate([tuple(sorted(perms[k][:j + 1]))
-                                          for k in need])
+                    eval_fn([tuple(sorted(perms[k][:j + 1]))
+                             for k in need])
                 need_set = set(need)
                 for k in range(k_round):
                     key = tuple(sorted(int(x) for x in perms[k][:j + 1]))
                     if k in need_set:
-                        new_val = self.engine.charac_fct_values[key]
+                        new_val = values[key]
                     elif interpolate:
                         if np.isnan(interp_slope[k]):
                             size_of_rest = sizes[perms[k][j:]].sum()
@@ -275,6 +278,20 @@ class Contributivity:
             contributions = np.vstack([contributions, rows])
             t += k_round
             v_max = np.max(np.var(contributions, axis=0))
+        return contributions, t
+
+    def _tmc(self, sv_accuracy, alpha, truncation, interpolate, perm_batch=16):
+        name = "ITMCS" if interpolate else "TMC Shapley"
+        t0 = self._method_span(name)
+        n = self._n
+        v_all = float(self.engine.evaluate([tuple(range(n))])[0])
+        if n == 1:
+            self._finish(name, np.array([v_all]), np.array([0.0]), t0)
+            return
+        contributions, t = self._truncated_permutation_sweep(
+            n, v_all, self.engine.evaluate, self.engine.charac_fct_values,
+            sv_accuracy, alpha, truncation, interpolate, self._sizes(),
+            perm_batch)
         sv = np.mean(contributions, axis=0)
         std = np.std(contributions, axis=0) / np.sqrt(t - 1)
         self._finish(name, sv, std, t0)
@@ -649,6 +666,218 @@ class Contributivity:
         self._finish("WR_SMC Shapley", shap, np.sqrt(var), t0)
 
     # ------------------------------------------------------------------
+    # 15/16. Retrain-free estimators: GTG-Shapley reconstruction + SVARM
+    # (contrib/reconstruct.py — v(S) from ONE recorded grand-coalition
+    # run; coalition evals are eval-only batches through the engine's
+    # merged slot buckets, never training runs)
+    # ------------------------------------------------------------------
+
+    def _reconstructor(self):
+        """The engine's shared ReconstructionEvaluator, recording the
+        grand coalition on first use — ONE training run per scenario,
+        reused across retrain-free methods (the recording analog of the
+        shared coalition memo). Tests may pre-seat
+        `engine._reconstruction` with an analytic stub."""
+        eng = self.engine
+        if getattr(eng, "_reconstruction", None) is None:
+            from .reconstruct import ReconstructionEvaluator
+            eng._reconstruction = ReconstructionEvaluator(eng)
+        return eng._reconstruction
+
+    def _set_mc_trust(self, contributions, alpha, method):
+        """Feed the PR-6 trust row from a Monte-Carlo run: the iteration
+        rows split into up to 5 disjoint blocks whose means are
+        independent unbiased pseudo-replicas — Monte-Carlo uncertainty
+        (replica std, Kendall-tau rank stability, CIs) in the same report
+        row seed ensembles use, tagged source="mc_blocks" + the method
+        name so the row can't impersonate a seed-ensemble one."""
+        T = len(contributions)
+        if T < 2:
+            return
+        blocks = np.array_split(np.asarray(contributions), min(5, T), axis=0)
+        reps = np.stack([b.mean(axis=0) for b in blocks])
+        self.trust = {**trust_from_replicas(reps, alpha, source="mc_blocks"),
+                      "method": method}
+        obs_trace.event("contrib.trust", **self.trust)
+
+    def GTG_Shapley(self, sv_accuracy=0.01, alpha=0.95, truncation=None,
+                    perm_batch=16, min_iter=100):
+        """GTG-Shapley (arXiv:2109.02053): truncated-permutation Shapley
+        over RECONSTRUCTED coalition models — zero coalition training
+        passes beyond the one recorded grand-coalition run. The paper's
+        within-round truncation rule prunes a permutation's remaining
+        positions once |v(N) - v(prefix)| < `truncation` (default from
+        MPLC_TPU_GTG_TRUNCATION, 0.05); with the whole recorded
+        trajectory replayed per reconstruction, the full training run is
+        the one "round" the rule applies within (the per-round
+        decomposition of the paper collapses — documented deviation,
+        doc/documentation.md "Retrain-free estimators")."""
+        t0 = self._method_span("GTG-Shapley")
+        logger.info("# Launching GTG-Shapley (retrain-free reconstruction)")
+        n = self._n
+        try:
+            recon = self._reconstructor()
+        except BaseException:
+            # the reconstructor raises in normal use (2-D guard, all-
+            # partners-dropped, propagated recording OOM): drop the open
+            # method span or every later engine.evaluate would attribute
+            # its memo traffic to this method via active_span
+            t0.cancel()
+            raise
+        if truncation is None:
+            truncation = constants._env_nonneg_float(
+                constants.GTG_TRUNCATION_ENV, 0.05)
+        v_all = float(recon.evaluate([tuple(range(n))])[0])
+        if n == 1:
+            self._finish("GTG-Shapley", np.array([v_all]),
+                         np.array([0.0]), t0)
+            return
+        contributions, t = self._truncated_permutation_sweep(
+            n, v_all, recon.evaluate, recon.values, sv_accuracy, alpha,
+            truncation, False, self._sizes(), perm_batch, min_iter)
+        sv = np.mean(contributions, axis=0)
+        std = np.std(contributions, axis=0) / np.sqrt(t - 1)
+        self._set_mc_trust(contributions, alpha, "GTG-Shapley")
+        self._finish("GTG-Shapley", sv, std, t0)
+
+    def SVARM(self, budget=None, alpha=0.95, block=64):
+        """SVARM ("Approximating the Shapley Value without Marginal
+        Contributions", arXiv:2302.00736): stratified sampling where ONE
+        evaluated coalition A updates the plus-strata estimates of every
+        member and the minus-strata estimates of every non-member — no
+        paired (S, S u {i}) marginals, so whole sample blocks pack into
+        single eval batches. Runs retrain-free over reconstructed models;
+        strata 0 and n-1 are exact anchors, every other (partner, size)
+        stratum gets a guaranteed warm-up sample, then `budget` sampled
+        coalitions (MPLC_TPU_SVARM_SAMPLES; auto max(4 n^2, 128))."""
+        t0 = self._method_span("SVARM")
+        logger.info("# Launching SVARM (stratified, marginal-free sampling)")
+        n = self._n
+        try:
+            recon = self._reconstructor()
+        except BaseException:
+            # same span hygiene as GTG_Shapley: a leaked open method span
+            # would mis-attribute every later method's memo counters
+            t0.cancel()
+            raise
+        full = tuple(range(n))
+        v_all = float(recon.evaluate([full])[0])
+        if n == 1:
+            self._finish("SVARM", np.array([v_all]), np.array([0.0]), t0)
+            return
+        if budget is None:
+            budget = constants._env_nonneg_int(
+                constants.SVARM_SAMPLES_ENV, 0) or max(4 * n * n, 128)
+        # exact anchors: strata s=0 (v({i}), v(empty)) and s=n-1
+        # (v(N), v(N \ {i})) need no sampling at all
+        recon.evaluate([(i,) for i in range(n)]
+                       + [tuple(sorted(set(range(n)) - {i}))
+                          for i in range(n)])
+        vals = recon.values
+        exact_plus = np.full((n, n), np.nan)
+        exact_minus = np.full((n, n), np.nan)
+        for i in range(n):
+            exact_plus[i, 0] = vals[(i,)]
+            exact_minus[i, 0] = 0.0
+            exact_plus[i, n - 1] = v_all
+            exact_minus[i, n - 1] = vals[tuple(sorted(set(range(n)) - {i}))]
+        psum = np.zeros((n, n))
+        psq = np.zeros((n, n))
+        pcnt = np.zeros((n, n))
+        msum = np.zeros((n, n))
+        msq = np.zeros((n, n))
+        mcnt = np.zeros((n, n))
+        K_rep = 5  # pseudo-replica accumulators for the trust row
+        rp = np.zeros((K_rep, n, n))
+        rpc = np.zeros((K_rep, n, n))
+        rm = np.zeros((K_rep, n, n))
+        rmc = np.zeros((K_rep, n, n))
+
+        # guaranteed coverage: one warm-up draw per non-exact stratum,
+        # updating only its designated (sign, i, s) cell
+        warm = svarm_warmup_draws(n, self._rng)
+        recon.evaluate([w[3] for w in warm if w[3]])
+        for sign, i, s, A in warm:
+            v = vals[A] if A else 0.0
+            if sign == "plus":
+                psum[i, s] += v
+                psq[i, s] += v * v
+                pcnt[i, s] += 1
+            else:
+                msum[i, s] += v
+                msq[i, s] += v * v
+                mcnt[i, s] += 1
+
+        it = 0
+        drawn = 0
+        # n < 3 has no non-exact stratum: the anchors above already
+        # determine every phi exactly and svarm_batch_draws returns []
+        while n >= 3 and drawn < budget:
+            # each draw is an (A+, A-) PAIR — two sampled coalitions —
+            # so the coalition budget buys ceil(remaining / 2) pairs
+            draws = svarm_batch_draws(
+                n, min(block, max(1, (budget - drawn + 1) // 2)),
+                self._rng)
+            recon.evaluate([a for pair in draws for a in pair if a])
+            for ap, am in draws:
+                rep = it % K_rep
+                it += 1
+                va = vals[ap]
+                sa = len(ap) - 1
+                for i in ap:
+                    if np.isnan(exact_plus[i, sa]):
+                        psum[i, sa] += va
+                        psq[i, sa] += va * va
+                        pcnt[i, sa] += 1
+                        rp[rep, i, sa] += va
+                        rpc[rep, i, sa] += 1
+                vb = vals[am] if am else 0.0
+                sb = len(am)
+                in_a = set(am)
+                for i in range(n):
+                    if i in in_a or not np.isnan(exact_minus[i, sb]):
+                        continue
+                    msum[i, sb] += vb
+                    msq[i, sb] += vb * vb
+                    mcnt[i, sb] += 1
+                    rm[rep, i, sb] += vb
+                    rmc[rep, i, sb] += 1
+            drawn += 2 * len(draws)
+
+        pmean = np.where(~np.isnan(exact_plus), np.nan_to_num(exact_plus),
+                         psum / np.maximum(pcnt, 1))
+        mmean = np.where(~np.isnan(exact_minus), np.nan_to_num(exact_minus),
+                         msum / np.maximum(mcnt, 1))
+        sv = (pmean - mmean).mean(axis=1)
+
+        def sem2(sumv, sq, cnt):
+            # variance of each stratum MEAN (unbiased sample variance /
+            # count); exact strata carry count 0 and contribute 0
+            c = np.maximum(cnt, 1)
+            var = np.maximum(sq / c - (sumv / c) ** 2, 0.0)
+            var = np.where(cnt > 1, var * cnt / np.maximum(cnt - 1, 1), 0.0)
+            return np.where(cnt > 0, var / c, 0.0)
+
+        var_i = (sem2(psum, psq, pcnt) + sem2(msum, msq, mcnt)).sum(axis=1) \
+            / n ** 2
+        std = np.sqrt(var_i)
+
+        reps = np.zeros((K_rep, n))
+        for r in range(K_rep):
+            pm = np.where(~np.isnan(exact_plus), np.nan_to_num(exact_plus),
+                          np.where(rpc[r] > 0,
+                                   rp[r] / np.maximum(rpc[r], 1), pmean))
+            mm = np.where(~np.isnan(exact_minus),
+                          np.nan_to_num(exact_minus),
+                          np.where(rmc[r] > 0,
+                                   rm[r] / np.maximum(rmc[r], 1), mmean))
+            reps[r] = (pm - mm).mean(axis=1)
+        self.trust = {**trust_from_replicas(reps, alpha, source="mc_blocks"),
+                      "method": "SVARM"}
+        obs_trace.event("contrib.trust", **self.trust)
+        self._finish("SVARM", sv, std, t0)
+
+    # ------------------------------------------------------------------
     # 10/11/12. Federated step-by-step scores (history post-processing)
     # ------------------------------------------------------------------
 
@@ -823,5 +1052,11 @@ class Contributivity:
             self.PVRL(learning_rate=0.2)
         elif method_to_compute == "LFlip":
             self.flip_label()
+        elif method_to_compute == "GTG-Shapley":
+            # truncation=None: GTG's own within-round threshold (the
+            # MPLC_TPU_GTG_TRUNCATION default), not TMCS's `truncation`
+            self.GTG_Shapley(sv_accuracy=sv_accuracy, alpha=alpha)
+        elif method_to_compute == "SVARM":
+            self.SVARM(alpha=alpha)
         else:
             logger.warning("Unrecognized name of method, statement ignored!")

@@ -65,6 +65,25 @@ def _bucket_size(n: int, n_dev: int, cap_per_dev: int) -> int:
     return min(b, cap)
 
 
+def _memo_counters(hits: int, misses: int) -> "str | None":
+    """Global + per-estimator-method memo accounting, shared by the exact
+    engine and the reconstruction evaluator (contrib/reconstruct.py) so
+    the counter keys and the method-attribution rule can't drift apart.
+    The method comes from the enclosing `contributivity` span — mixed-
+    method runs can attribute memo wins the global
+    `not_twice_characteristic` stats can't. Returns the method (or None)
+    for the caller's own span attrs."""
+    obs_metrics.counter("engine.memo_hits").inc(hits)
+    obs_metrics.counter("engine.memo_misses").inc(misses)
+    method_span = obs_trace.active_span("contributivity")
+    method = (method_span.attrs.get("method")
+              if method_span is not None else None)
+    if method:
+        obs_metrics.counter(f"engine.memo_hits[{method}]").inc(hits)
+        obs_metrics.counter(f"engine.memo_misses[{method}]").inc(misses)
+    return method
+
+
 class CacheIntegrityError(ValueError):
     """A coalition cache file is unreadable AS A FILE — truncated write,
     corrupted bytes, checksum mismatch, missing payload keys. Distinct
@@ -262,6 +281,13 @@ class CharacteristicEngine:
 
     def __init__(self, scenario, share_data_from: "CharacteristicEngine | None" = None,
                  seed_ensemble: int | None = None):
+        # Persistent compilation cache (MPLC_TPU_COMPILE_CACHE_DIR):
+        # configured before this engine's first trace/compile, so repeated
+        # sweeps — and service restarts — reload executables from disk
+        # instead of recompiling the slot pipelines. Idempotent no-op when
+        # the knob is unset.
+        from ..utils import enable_compile_cache_from_env
+        enable_compile_cache_from_env()
         self.scenario = scenario
         self.partners_list = sorted(scenario.partners_list, key=lambda p: p.id)
         self.partners_count = len(self.partners_list)
@@ -814,6 +840,12 @@ class CharacteristicEngine:
 
     def _run_batch(self, subsets: list[tuple], pipe,
                    slot_count: int | None = None) -> None:
+        # NOTE: the dispatch/harvest recovery skeleton here (bucket-width
+        # recompute on cap change, dispatch-OOM degrade-and-retry,
+        # harvest-OOM rewind, batch-event emission) is deliberately
+        # mirrored by ReconstructionEvaluator._run_batch
+        # (contrib/reconstruct.py) for eval-only reconstruction batches —
+        # ladder changes must land in both.
         # overlap is only possible when the pipe dispatches without host
         # decisions inside (no mid-run ES sync) — otherwise pipelining
         # degenerates to the sequential path and must not halve the cap
@@ -1305,12 +1337,11 @@ class CharacteristicEngine:
                 n_requested_missing - len(missing))
         # memo accounting over unique keys: intra-call duplicates don't
         # inflate the hit rate
-        obs_metrics.counter("engine.memo_hits").inc(
-            len(unique) - n_requested_missing)
-        obs_metrics.counter("engine.memo_misses").inc(len(missing))
+        method = _memo_counters(len(unique) - n_requested_missing,
+                                len(missing))
         obs_metrics.counter("engine.coalitions_evaluated").inc(len(missing))
         with obs_trace.span("engine.evaluate", requested=len(unique),
-                            missing=len(missing)):
+                            missing=len(missing), method=method):
             if self._forever_dropped:
                 # route by EFFECTIVE size: a coalition reduced to one
                 # survivor is a single-partner training (the reference's

@@ -1,0 +1,403 @@
+"""Retrain-free coalition reconstruction (GTG-Shapley, arXiv:2109.02053).
+
+Every estimator before this module pays a full retrain-from-scratch per
+coalition, so even the fused/pipelined sweep engine is bounded by
+2^P x epochs of TRAINING work. GTG-Shapley's observation: during ONE
+grand-coalition FedAvg run, record every aggregation round's per-partner
+parameter delta and weight; any coalition S's model can then be
+*reconstructed* by replaying the recorded rounds restricted to S —
+
+    M_S^r = M_S^{r-1} + sum_{p in S} w~_p^r * delta_p^r,
+    w~ = the recorded weights renormalized over S
+
+— a weighted aggregation, i.e. the same computational shape as a
+slot-engine step, fused here as one `lax.scan` over the recorded rounds,
+vmapped over a batch of coalition masks. v(S) then costs one EVAL-ONLY
+batch instead of a training run, changing the asymptotics: training
+passes become O(P x epochs) total (the single recording run) instead of
+O(2^P x P x epochs).
+
+Execution contract (mirrors contrib/engine.py deliberately):
+
+  - Reconstructed coalitions pack into the SAME merged slot buckets as
+    trained ones (`engine._slot_buckets` / `_bucket_size` / the engine's
+    device-batch cap), so eval programs bucket and pad exactly like the
+    training sweep's — `engine.batch` events are emitted per batch with
+    `eval_only=True`, zero epochs and zero partner passes.
+  - Every dispatch/harvest boundary rides the engine's PR-4 recovery
+    ladder: the shared fault injector fires at the engine's batch
+    ordinals, transients retry bit-identically, RESOURCE_EXHAUSTED steps
+    the shared cap-halving ladder down (re-bucketing the remaining
+    subsets), and the exhausted ladder falls back to a host-CPU
+    reconstruction of the tail. Row-independent vmapped evaluation makes
+    every recovered value bit-identical to the fault-free one
+    (equality-tested in tests/test_reconstruct.py).
+  - Reconstructed values live in their OWN memo (`self.values`), never in
+    `engine.charac_fct_values`: reconstruction is an approximation of the
+    retrained v(S), and the exact memo (and its persisted caches) must
+    never be silently poisoned by it.
+
+Interaction with the partner fault model: dropped partners record
+exactly-zero deltas and zero weights (masked-to-zero gradients), so a
+reconstruction over any S renormalizes over the survivors exactly like
+the live trainer. With seed ensembles the recording run uses the
+engine's base seed — replica 0's game — and the retrain-free estimators
+derive their trust row from Monte-Carlo sample blocks instead of seed
+replicas (contrib/contributivity.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import faults
+from ..mpl.engine import MplTrainer
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from .engine import _bucket_size, _memo_counters
+
+
+@dataclasses.dataclass
+class RecordedRun:
+    """One grand-coalition training's recorded update stream."""
+    init_params: object       # pytree: the run's initial global params
+    deltas: object            # pytree, leaves [R, P, ...]: per-round deltas
+    weights: jax.Array        # [R, P] normalized aggregation weights
+    rounds: int               # R = epoch_count x minibatch_count
+    partners_count: int
+    epochs_done: int          # epochs actually trained (early stopping)
+    training_passes: int      # partner passes the recording run paid
+    memory_bytes: int         # recorded-update device memory footprint
+
+    def describe(self) -> dict:
+        return {"rounds": self.rounds, "partners": self.partners_count,
+                "epochs": self.epochs_done,
+                "training_passes": self.training_passes,
+                "memory_bytes": self.memory_bytes}
+
+
+def _check_not_2d(engine) -> None:
+    """Fail fast (same guard pattern as seed_ensemble): update recording
+    and the 2-D coalition x data mode are mutually exclusive — the
+    recorded [rounds, partners, ...] stack needs the whole partner axis
+    resident, which is exactly what the 2-D mode exists to avoid."""
+    if getattr(engine, "_pipe2d", None) is not None:
+        raise ValueError(
+            "update recording (retrain-free GTG-Shapley/SVARM) is not "
+            "supported in the 2-D partner-sharded mode "
+            "(MPLC_TPU_PARTNER_SHARDS > 1): the recorded per-partner "
+            "update stack needs the whole partner axis resident per "
+            "device. Run the retrain-free estimators on the 1-D coalition "
+            "mesh, or use the retraining estimators in 2-D mode.")
+
+
+def record_updates(engine) -> RecordedRun:
+    """Train the grand coalition ONCE with update recording on and return
+    the recorded stream. The run trains through the engine's own
+    TrainConfig (same epochs/minibatches/aggregator/fault plan) on the
+    masked fedavg path, keyed by the grand coalition's effective rng
+    stream — so the recorded trajectory is the same game the engine's
+    cache fingerprint describes. The recording dispatch is a batch
+    boundary for the fault plan: transients retry bit-identically (the
+    stream is deterministic); an OOM here propagates — a single
+    grand-coalition training has no narrower width to degrade to."""
+    _check_not_2d(engine)
+    cfg = dataclasses.replace(engine._multi_cfg, record_updates=True)
+    trainer = MplTrainer.get(engine.model, cfg)
+    P = engine.partners_count
+    full = tuple(range(P))
+    eff = engine._effective_subset(full)
+    if not eff:
+        raise ValueError("every partner is dropped from epoch 1 — there is "
+                         "no grand-coalition run to record")
+    rng = engine._coalition_rng(eff)
+    mask = jnp.asarray(engine._coalition_arrays([full], None)[0])
+
+    engine._batch_ordinal += 1
+    ordinal = engine._batch_ordinal
+    span = obs_trace.start_span("recon.record", partners=P,
+                                rounds=cfg.epoch_count * cfg.minibatch_count)
+    t0 = time.perf_counter()
+
+    def dispatch():
+        with obs_trace.span("engine.dispatch", width=1, slot_count=None,
+                            coalitions=1, padding=0, recording=True):
+            engine._faults.check("dispatch", ordinal)
+            state = trainer.init_state(rng, P)
+            init_params = state.params
+            if cfg.is_early_stopping:
+                chunk = max(1, min(cfg.patience, cfg.epoch_count))
+                epochs_left = cfg.epoch_count
+                while epochs_left > 0:
+                    n = min(chunk, epochs_left)
+                    state = trainer.jit_epoch_chunk(state, engine.stacked,
+                                                    engine.val, mask, rng,
+                                                    n_epochs=n)
+                    epochs_left -= n
+                    if bool(jax.device_get(state.done)):
+                        break
+            else:
+                state = trainer.jit_epoch_chunk(state, engine.stacked,
+                                                engine.val, mask, rng,
+                                                n_epochs=cfg.epoch_count)
+            return init_params, state
+
+    try:
+        init_params, state = engine._retry_transient(dispatch, "dispatch")
+    except BaseException:
+        # the documented propagation path (exhausted retries, OOM — a
+        # single grand-coalition run has nothing to degrade to, and crash
+        # faults are BaseException): drop the open span without emitting
+        # so the thread-local nesting stays intact for the caller
+        span.cancel()
+        raise
+    epochs = int(jax.device_get(state.nb_epochs_done))
+    rounds = cfg.epoch_count * cfg.minibatch_count
+    passes = epochs * cfg.minibatch_count * P
+    samples = epochs * int(sum(int(engine._epoch_samples_multi[i])
+                               for i in eff))
+    mem = int(sum(np.prod(l.shape) * l.dtype.itemsize
+                  for l in jax.tree_util.tree_leaves(state.upd_h))
+              + state.w_h.size * state.w_h.dtype.itemsize)
+    rec = RecordedRun(init_params=init_params, deltas=state.upd_h,
+                      weights=state.w_h, rounds=rounds, partners_count=P,
+                      epochs_done=epochs, training_passes=passes,
+                      memory_bytes=mem)
+    # the recording run IS training work: it owns every training-side
+    # counter the retrain-free sweep will show (the asymptotic claim —
+    # "partner passes only from the recording run" — is asserted against
+    # exactly these)
+    engine.epochs_trained += epochs
+    engine.samples_trained += samples
+    obs_metrics.counter("engine.batches").inc()
+    obs_metrics.counter("engine.epochs_trained").inc(epochs)
+    obs_metrics.counter("engine.samples_trained").inc(samples)
+    obs_metrics.counter("engine.partner_passes").inc(passes)
+    obs_trace.event("engine.batch", dur=time.perf_counter() - t0, width=1,
+                    slot_count=None, coalitions=1, padding=0, epochs=epochs,
+                    samples=samples, partner_passes=passes, recording=True)
+    for k, v in rec.describe().items():
+        span.attrs[k] = v
+    span.end()
+    return rec
+
+
+class ReconstructionEvaluator:
+    """Memoizing, batching v(S) over RECONSTRUCTED coalition models.
+
+    The estimator-facing mirror of `CharacteristicEngine.evaluate`: same
+    bucket grouping, same cap/width machinery, same fault ladder, same
+    span/event vocabulary — but each batch is one fused
+    reconstruct-then-evaluate program instead of a training run."""
+
+    def __init__(self, engine, recorded: RecordedRun | None = None):
+        _check_not_2d(engine)
+        self.engine = engine
+        self.recorded = recorded if recorded is not None \
+            else record_updates(engine)
+        self.values: dict[tuple, float] = {(): 0.0}
+        self.reconstructions = 0
+        self._fn = None
+        self._cpu_rec = None
+
+    # -- the fused reconstruct+eval program ------------------------------
+
+    def _batch_eval_fn(self):
+        if self._fn is None:
+            trainer = self.engine.multi_pipe.trainer
+
+            def batch_eval(masks, init_params, deltas, weights, test):
+                def one(mask):
+                    def round_step(params, xs):
+                        delta, w = xs          # [P, ...] leaves, [P]
+                        ws = w * mask
+                        denom = jnp.sum(ws)
+                        # rounds the recording never reached (early stop)
+                        # and rounds where no member survived carry zero
+                        # weight: the model passes through unchanged
+                        wn = jnp.where(denom > 0,
+                                       ws / jnp.maximum(denom, 1e-12), 0.0)
+                        upd = jax.tree_util.tree_map(
+                            lambda d: jnp.tensordot(
+                                wn.astype(d.dtype), d, axes=([0], [0])),
+                            delta)
+                        return jax.tree_util.tree_map(
+                            lambda p, u: p + u, params, upd), None
+
+                    params, _ = lax.scan(round_step, init_params,
+                                         (deltas, weights))
+                    return trainer.evaluate(params, test)[1]
+
+                return jax.vmap(one)(masks)
+
+            self._fn = jax.jit(batch_eval)
+        return self._fn
+
+    def _apply(self, masks: jax.Array) -> jax.Array:
+        rec = self.recorded
+        return self._batch_eval_fn()(masks, rec.init_params, rec.deltas,
+                                     rec.weights, self.engine.test)
+
+    def _apply_cpu(self, masks: np.ndarray) -> jax.Array:
+        """Terminal OOM-ladder rung: reconstruct+evaluate on the host CPU
+        with a host-pinned copy of the recorded stream (same program, same
+        row-independent math — bit-identical values)."""
+        cpu = jax.local_devices(backend="cpu")[0]
+        if self._cpu_rec is None:
+            put = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda a: jax.device_put(a, cpu), t)
+            rec = self.recorded
+            self._cpu_rec = (put(rec.init_params), put(rec.deltas),
+                             put(rec.weights), put(self.engine.test))
+        ip, d, w, test = self._cpu_rec
+        with jax.default_device(cpu):
+            return self._batch_eval_fn()(
+                jax.device_put(jnp.asarray(masks), cpu), ip, d, w, test)
+
+    # -- estimator-facing API --------------------------------------------
+
+    def evaluate(self, subsets) -> np.ndarray:
+        """Batched memoized reconstructed v(S); values in input order."""
+        eng = self.engine
+        keys = [tuple(sorted(int(i) for i in s)) for s in subsets]
+        unique = dict.fromkeys(keys)
+        missing = [k for k in unique if k not in self.values]
+        n_requested_missing = len(missing)
+        if eng._forever_dropped:
+            # same exact-null-player rule as the engine: a coalition whose
+            # EVERY member is dropped from epoch 1 has v = 0 by definition
+            # — its recorded weights are all-zero, so the scan would pass
+            # the INIT params through and score the untrained model's
+            # chance accuracy instead, crediting a null player
+            live = []
+            for k in missing:
+                if all(i in eng._forever_dropped for i in k):
+                    self.values[k] = 0.0
+                else:
+                    live.append(k)
+            obs_metrics.counter("engine.null_coalitions").inc(
+                n_requested_missing - len(live))
+            missing = live
+        method = _memo_counters(len(unique) - n_requested_missing,
+                                len(missing))
+        with obs_trace.span("engine.evaluate", requested=len(unique),
+                            missing=len(missing), mode="reconstruct",
+                            method=method):
+            # same routing as the training sweep: singles as their own
+            # group, multis through the engine's merged slot buckets so
+            # reconstructed batches share the sweep's exact widths
+            singles = [k for k in missing if len(k) == 1]
+            multis = [k for k in missing if len(k) > 1]
+            if singles:
+                self._run_batch(singles, None)
+            for slot_count, group in eng._slot_buckets(multis):
+                self._run_batch(group, slot_count)
+        return np.array([self.values[k] for k in keys])
+
+    def _run_batch(self, subsets: list[tuple],
+                   slot_count: int | None) -> None:
+        eng = self.engine
+        n = len(subsets)
+
+        def bucket_width() -> int:
+            n_dev = 1 if eng._cpu_degraded else max(
+                eng._sharding.num_devices if eng._sharding else 1, 1)
+            cap = eng._device_batch_cap(slot_count, False)
+            return _bucket_size(min(n, n_dev * cap), n_dev, cap)
+
+        b = bucket_width()
+        halvings_seen = eng._cap_halvings
+        with obs_trace.span("engine.prep", coalitions=n, width=b,
+                            slot_count=slot_count):
+            masks_all = eng._coalition_arrays(subsets, None)
+
+        i = 0
+        while i < n:
+            if eng._cap_halvings != halvings_seen or \
+                    (eng._cpu_degraded and b > 1):
+                halvings_seen = eng._cap_halvings
+                b = bucket_width()
+            group = subsets[i:i + b]
+            sel = np.full(b, i, np.intp)
+            sel[:len(group)] = np.arange(i, i + len(group))
+            eng._batch_ordinal += 1
+            on_cpu = eng._cpu_degraded  # terminal rung at dispatch time
+            attrs = {"width": b, "slot_count": slot_count,
+                     "coalitions": len(group), "padding": b - len(group),
+                     "eval_only": True}
+            if on_cpu:
+                attrs["degraded"] = "cpu"
+            meta = {**attrs, "t0": time.perf_counter(),
+                    "ordinal": eng._batch_ordinal}
+
+            def dispatch(sel=sel, attrs=attrs, ordinal=eng._batch_ordinal):
+                with obs_trace.span("engine.dispatch", **attrs):
+                    eng._faults.check("dispatch", ordinal)
+                    if eng._cpu_degraded:
+                        accs = self._apply_cpu(masks_all[sel])
+                    else:
+                        m = jnp.asarray(masks_all[sel])
+                        if eng._sharding is not None:
+                            m = jax.device_put(
+                                m, eng._sharding.batch_sharding)
+                        accs = self._apply(m)
+                    return lambda: np.asarray(jax.device_get(accs))
+
+            meta["redispatch"] = dispatch
+            try:
+                fetch = eng._retry_transient(dispatch, "dispatch")
+            except Exception as e:
+                if not faults.is_oom(e) or on_cpu:
+                    # the CPU rung is TERMINAL (matches the engine's
+                    # _run_groups_cpu): an OOM there must propagate, not
+                    # re-enter the ladder and livelock on the same batch
+                    raise
+                # dispatch-side OOM: step the shared ladder down and retry
+                # THIS group (i unchanged) at the degraded width; past the
+                # last rung the loop re-enters via the CPU path above
+                eng._degrade_cap(e)
+                continue
+            i += len(group)
+            try:
+                with obs_trace.span("engine.harvest", width=b,
+                                    slot_count=slot_count,
+                                    coalitions=len(group)):
+                    accs = eng._fetch_with_retry(fetch, meta)
+            except Exception as e:
+                if not faults.is_oom(e) or on_cpu:
+                    raise  # CPU rung is terminal here too
+                # harvest-side OOM: nothing of this group was memoized yet
+                # — rewind and re-dispatch it at the degraded width
+                eng._degrade_cap(e)
+                i -= len(group)
+                continue
+            for s, acc in zip(group, accs[:len(group)]):
+                self.values[s] = float(acc)
+            self.reconstructions += len(group)
+            obs_metrics.counter("engine.batches").inc()
+            obs_metrics.counter("engine.reconstructions").inc(len(group))
+            obs_metrics.histogram("engine.pad_waste_fraction").observe(
+                (b - len(group)) / b)
+            extra = {}
+            if meta.get("degraded"):
+                extra["degraded"] = meta["degraded"]
+                obs_metrics.counter("engine.cpu_degraded_batches").inc()
+                obs_metrics.counter("engine.cpu_degraded_coalitions").inc(
+                    len(group))
+            # eval-only batch: zero epochs / samples / partner passes — the
+            # sweep report's reconstruction row derives the eval-vs-train
+            # split from exactly this shape
+            obs_trace.event("engine.batch",
+                            dur=time.perf_counter() - meta["t0"], width=b,
+                            slot_count=slot_count, coalitions=len(group),
+                            padding=b - len(group), epochs=0, samples=0,
+                            partner_passes=0, eval_only=True, **extra)
+            if eng.progress is not None:
+                eng.progress(len(group), n - i, slot_count)

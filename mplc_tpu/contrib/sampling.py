@@ -198,6 +198,70 @@ class SizeStratifiedSubsetSampler:
         return subset, float(self._weight_per_size[length])
 
 
+# ---------------------------------------------------------------------------
+# SVARM stratified sampling ("Approximating the Shapley Value without
+# Marginal Contributions", arXiv:2302.00736). The Shapley value splits as
+#
+#   phi_i = (1/n) * sum_{s=0}^{n-1} (phi+_{i,s} - phi-_{i,s}),
+#   phi+_{i,s} = E[v(S u {i})],  phi-_{i,s} = E[v(S)]   over uniform
+#                size-s subsets S of N \ {i}
+#
+# so ONE sampled coalition A updates phi+ estimates for every i in A
+# (stratum |A|-1) and phi- estimates for every i not in A (stratum |A|) —
+# no paired (S, S u {i}) marginal evaluations at all, which is what lets a
+# whole sample block pack into one eval batch. Uniformity is inherited:
+# A uniform among size-s sets, conditioned on i in A, has A \ {i} uniform
+# among size-(s-1) subsets of N \ {i}.
+# ---------------------------------------------------------------------------
+
+def svarm_warmup_draws(n: int, rng: np.random.Generator
+                       ) -> list[tuple[str, int, int, tuple]]:
+    """One guaranteed sample per non-exact stratum: for every partner i
+    and size s in 1..n-2, one uniform S subset of N\\{i} for the minus
+    estimator and its i-joined set for the plus estimator. (Strata s=0 and
+    s=n-1 are exact anchors — v({i}), v(empty), v(N), v(N\\{i}) — and need
+    no samples.) Returns (sign, i, s, coalition) entries; each warm-up
+    coalition updates ONLY its designated stratum, keeping every stratum
+    mean a mean of uniform draws."""
+    draws = []
+    for i in range(n):
+        others = np.delete(np.arange(n), i)
+        for s in range(1, n - 1):
+            sp = rng.choice(others, s, replace=False)
+            draws.append(("plus", i, s,
+                          tuple(sorted([int(x) for x in sp] + [i]))))
+            sm = rng.choice(others, s, replace=False)
+            draws.append(("minus", i, s,
+                          tuple(sorted(int(x) for x in sm))))
+    return draws
+
+
+def svarm_batch_draws(n: int, block: int, rng: np.random.Generator
+                      ) -> list[tuple[tuple, tuple]]:
+    """`block` main-loop iterations of (A_plus, A_minus) coalition pairs:
+    A_plus uniform among sets of a uniform size 2..n-1 (updates plus
+    strata for its members), A_minus uniform among sets of a uniform
+    size 1..n-2 (updates minus strata for its non-members). Sizes that
+    would only touch the exact anchor strata (|A+| in {1, n}, |A-| in
+    {0, n-1}) are excluded — their updates are skipped anyway, so
+    sampling them would burn budget on no-op evaluations; conditional
+    uniformity within each remaining stratum is unchanged. n < 3 has no
+    non-exact stratum at all: returns [] (the caller's sampling loop
+    must not spin on an empty block)."""
+    if n < 3:
+        return []
+    out = []
+    for _ in range(block):
+        sp = int(rng.integers(2, n))
+        ap = tuple(sorted(int(x) for x in
+                          rng.choice(n, sp, replace=False)))
+        sm = int(rng.integers(1, n - 1))
+        am = tuple(sorted(int(x) for x in
+                          rng.choice(n, sm, replace=False)))
+        out.append((ap, am))
+    return out
+
+
 def make_importance_sampler(n: int, k: int, batch_fn,
                             rng: np.random.Generator,
                             max_exact_bits: int = MAX_EXACT_BITS):

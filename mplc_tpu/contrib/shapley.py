@@ -137,17 +137,25 @@ def confidence_intervals(sv_samples: np.ndarray, alpha: float = 0.95
     return mean, mean - half, mean + half
 
 
-def trust_summary(n: int, samples_of: dict, alpha: float = 0.95) -> dict:
-    """The sweep report's `trust` row: per-partner Shapley mean / std /
-    CI bounds over the seed ensemble plus the Kendall-tau rank-stability
-    score. Plain lists and floats — JSON-ready for the telemetry
-    sidecar."""
-    sv = shapley_sample_matrix(n, samples_of)
+def trust_from_replicas(sv_samples, alpha: float = 0.95,
+                        source: str = "replicas") -> dict:
+    """The `trust` row dict from an explicit [K, n] replica Shapley
+    matrix. Two producers share it: seed-ensemble sweeps (replicas =
+    independent seeds, via `trust_summary`, source="seed_ensemble") and
+    the retrain-free MC estimators (replicas = disjoint sample blocks of
+    one run — Monte-Carlo uncertainty rather than seed volatility,
+    source="mc_blocks"). `source` is carried in the row so a report/
+    sidecar reader can tell seed volatility from sampling noise — the
+    two rows are otherwise schema-identical. Plain lists and floats —
+    JSON-ready for the telemetry sidecar."""
+    sv = np.asarray(sv_samples, float)
+    n = sv.shape[1]
     mean, lo, hi = confidence_intervals(sv, alpha)
     std = (sv.std(axis=0, ddof=1) if sv.shape[0] > 1
            else np.zeros(n))
     return {
         "ensemble": int(sv.shape[0]),
+        "source": source,
         "alpha": float(alpha),
         "mean": [float(x) for x in mean],
         "std": [float(x) for x in std],
@@ -155,3 +163,11 @@ def trust_summary(n: int, samples_of: dict, alpha: float = 0.95) -> dict:
         "ci_high": [float(x) for x in hi],
         "kendall_tau": rank_stability(sv),
     }
+
+
+def trust_summary(n: int, samples_of: dict, alpha: float = 0.95) -> dict:
+    """The sweep report's `trust` row: per-partner Shapley mean / std /
+    CI bounds over the seed ensemble plus the Kendall-tau rank-stability
+    score."""
+    return trust_from_replicas(shapley_sample_matrix(n, samples_of), alpha,
+                               source="seed_ensemble")

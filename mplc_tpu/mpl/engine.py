@@ -161,6 +161,19 @@ class TrainConfig:
     # trainer-registry key, exactly like slot_count.
     partner_drop_epochs: tuple | None = None
     partner_straggler_delays: tuple | None = None
+    # Update-recording mode (retrain-free contributivity,
+    # contrib/reconstruct.py): capture every aggregation round's
+    # per-partner parameter delta (local params - round-start global
+    # params) and the normalized aggregation weight vector actually used,
+    # stacked as device arrays on the TrainState (`upd_h` [R, P, ...]
+    # leaves, `w_h` [R, P]; R = epoch_count x minibatch_count rounds).
+    # Because inactive/dropped partners produce exactly-zero optimizer
+    # updates and zero aggregation weight, their recorded rows are exact
+    # zeros — the fault model composes for free. fedavg masked path only
+    # (the recording run is ONE grand-coalition training, where slot
+    # execution has nothing to save); off by default, and the off build
+    # is byte-identical to the pre-recording trainer.
+    record_updates: bool = False
 
     def __post_init__(self):
         if self.approach not in APPROACH_NAMES:
@@ -192,6 +205,21 @@ class TrainConfig:
             if self.partner_axis is not None:
                 raise ValueError("slot execution and partner-axis sharding "
                                  "are mutually exclusive")
+        if self.record_updates:
+            if self.approach != "fedavg":
+                raise ValueError(
+                    "update recording (record_updates) captures FedAvg "
+                    "aggregation-round deltas; it supports the fedavg "
+                    f"approach only, got '{self.approach}'")
+            if self.slot_count is not None:
+                raise ValueError("update recording runs the masked fedavg "
+                                 "path; slot execution is not supported")
+            if self.partner_axis is not None:
+                raise ValueError(
+                    "update recording is not supported with partner-axis "
+                    "sharding (the 2-D coalition x data mode): the "
+                    "recorded [rounds, partners, ...] update stack needs "
+                    "the whole partner axis resident per device")
 
     @property
     def dtype(self):
@@ -217,6 +245,10 @@ class TrainState(NamedTuple):
     stale: Any = ()          # [D, ...] rolling buffer of the last D post-
                              # aggregation global params (straggler faults
                              # only; () when no partner straggles)
+    upd_h: Any = ()          # [R, P, ...] per-round per-partner parameter
+                             # deltas (record_updates only; else ())
+    w_h: Any = ()            # [R, P] per-round normalized aggregation
+                             # weights (record_updates only; else ())
 
 
 class EvalSet(NamedTuple):
@@ -346,6 +378,17 @@ class MplTrainer:
             stale = broadcast(params, max(cfg.partner_straggler_delays))
         else:
             stale = ()
+        if cfg.record_updates:
+            # one recorded row per aggregation round; rounds the run never
+            # reaches (early stopping) stay all-zero, which the
+            # reconstruction scan skips via its zero-weight-denominator rule
+            R = E * MB
+            upd_h = jax.tree_util.tree_map(
+                lambda leaf: jnp.zeros((R, partners_count) + leaf.shape,
+                                       leaf.dtype), params)
+            w_h = jnp.zeros((R, partners_count), jnp.float32)
+        else:
+            upd_h = w_h = ()
         return TrainState(
             params=params, opt_state=opt_state, theta=theta, theta_h=theta_h,
             epoch=jnp.zeros((), jnp.int32), done=jnp.zeros((), bool),
@@ -355,7 +398,7 @@ class MplTrainer:
             val_loss_h=jnp.full((E, MB), jnp.nan, jnp.float32),
             val_acc_h=jnp.full((E, MB), jnp.nan, jnp.float32),
             partner_h=jnp.full((4, partners_count, E, MB), jnp.nan, jnp.float32),
-            stale=stale,
+            stale=stale, upd_h=upd_h, w_h=w_h,
         )
 
     # ------------------------------------------------------------------
@@ -630,11 +673,14 @@ class MplTrainer:
             else coal_mask
         stragglers = faulted and bool(cfg.partner_straggler_delays)
 
+        recording = cfg.record_updates
+
         def mb_body(carry, mb_i):
-            if stragglers:
-                params, theta, vl_h, va_h, p_h, stale = carry
-            else:
-                params, theta, vl_h, va_h, p_h = carry
+            # uniform carry: the straggler buffer and the recording stacks
+            # are empty pytrees (()) when their mode is off, so the scan
+            # structure — and the compiled program — matches the
+            # pre-recording build exactly in the off configuration
+            params, theta, vl_h, va_h, p_h, stale, upd_h, w_h = carry
             vl, va = self._maybe_val_eval(params, val, mb_i, es_col=0)
             vl_h = vl_h.at[e, mb_i].set(vl)
             va_h = va_h.at[e, mb_i].set(va)
@@ -686,6 +732,17 @@ class MplTrainer:
             w = aggregation_weights(cfg.aggregator, act_mask,
                                     stacked.sizes, jnp.nan_to_num(pva),
                                     axis_name=cfg.partner_axis)
+            if recording:
+                # the round's recorded row: per-partner delta from the
+                # round-start global params (inactive/dropped partners
+                # trained to exactly their start params, so their rows are
+                # exact zeros) and the normalized weight vector the
+                # aggregation below actually applies
+                r_idx = e * cfg.minibatch_count + mb_i
+                upd_h = jax.tree_util.tree_map(
+                    lambda h, loc, g: h.at[r_idx].set(loc - g),
+                    upd_h, new_params, params)
+                w_h = w_h.at[r_idx].set(w)
             agg = aggregate(new_params, w, axis_name=cfg.partner_axis)
             if faulted:
                 # a round with zero survivors (every coalition member
@@ -694,22 +751,16 @@ class MplTrainer:
                 agg = tree_where(jnp.sum(act_mask) > 0, agg, params)
             if stragglers:
                 stale = self._push_stale(stale, params)
-                return (agg, theta, vl_h, va_h, p_h, stale), None
-            return (agg, theta, vl_h, va_h, p_h), None
+            return (agg, theta, vl_h, va_h, p_h, stale, upd_h, w_h), None
 
-        if stragglers:
-            (params, theta, vl_h, va_h, p_h, stale), _ = lax.scan(
-                mb_body, (state.params, state.theta, state.val_loss_h,
-                          state.val_acc_h, state.partner_h, state.stale),
-                jnp.arange(cfg.minibatch_count))
-            return state._replace(params=params, theta=theta, val_loss_h=vl_h,
-                                  val_acc_h=va_h, partner_h=p_h, stale=stale)
-        (params, theta, vl_h, va_h, p_h), _ = lax.scan(
+        (params, theta, vl_h, va_h, p_h, stale, upd_h, w_h), _ = lax.scan(
             mb_body, (state.params, state.theta, state.val_loss_h,
-                      state.val_acc_h, state.partner_h),
+                      state.val_acc_h, state.partner_h, state.stale,
+                      state.upd_h, state.w_h),
             jnp.arange(cfg.minibatch_count))
         return state._replace(params=params, theta=theta, val_loss_h=vl_h,
-                              val_acc_h=va_h, partner_h=p_h)
+                              val_acc_h=va_h, partner_h=p_h, stale=stale,
+                              upd_h=upd_h, w_h=w_h)
 
     def _slot_binding(self, stacked, active_ids, rng):
         """Shared slot-execution prep: bind each slot to its partner's data

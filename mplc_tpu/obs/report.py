@@ -72,6 +72,10 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     cpu_batches = cpu_coalitions = 0
     faults_injected = 0
     trust = None
+    per_method: dict = {}
+    recon_batches = recon_coalitions = 0
+    recon_s = 0.0
+    recorded = None
 
     for rec in records:
         name = rec.get("name")
@@ -81,6 +85,13 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             evaluate_s += dur
             requested += int(a.get("requested", 0))
             missing += int(a.get("missing", 0))
+            m = a.get("method")
+            if m:
+                # per-estimator memo attribution (mixed-method runs):
+                # hits = requested - misses within THIS method's calls
+                d = per_method.setdefault(m, {"requested": 0, "misses": 0})
+                d["requested"] += int(a.get("requested", 0))
+                d["misses"] += int(a.get("missing", 0))
         elif name == "engine.prep":
             prep_s += dur
         elif name == "engine.dispatch":
@@ -112,6 +123,16 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             if a.get("degraded") == "cpu":
                 cpu_batches += 1
                 cpu_coalitions += int(a.get("coalitions", 0))
+            if a.get("eval_only"):
+                # reconstructed-coalition eval batch (retrain-free
+                # estimators): rides the same buckets but trains nothing
+                recon_batches += 1
+                recon_coalitions += int(a.get("coalitions", 0))
+                recon_s += dur
+        elif name == "recon.record":
+            # the grand-coalition recording run (one per engine); the last
+            # event wins, like the trust row
+            recorded = {**a, "seconds": dur}
         elif name == "engine.retry":
             retries += 1
             backoff_s += float(a.get("backoff_sec", 0.0))
@@ -183,6 +204,9 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             "hits": hits,
             "misses": missing,
             "hit_rate": requested_unique_hits / requested if requested else None,
+            # per-estimator memo attribution lands below, only when at
+            # least one engine.evaluate span carried a method — old
+            # (method-less) record streams keep the exact old schema
         },
         "batches": {
             "count": batches,
@@ -204,6 +228,33 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
         "compiles": compiles,
         "estimators": estimators,
     }
+    if per_method:
+        report["memo"]["per_method"] = {
+            m: {"requested": d["requested"],
+                "hits": max(d["requested"] - d["misses"], 0),
+                "misses": d["misses"],
+                "hit_rate": (max(d["requested"] - d["misses"], 0)
+                             / d["requested"]
+                             if d["requested"] else None)}
+            for m, d in sorted(per_method.items())}
+    if recon_batches or recorded is not None:
+        # retrain-free runs only: recorded-update memory, reconstruction
+        # throughput, and the eval-vs-train pass split that PROVES the
+        # asymptotic claim (training passes only from the recording run)
+        report["reconstruction"] = {
+            "recorded_rounds": (recorded or {}).get("rounds"),
+            "recorded_partners": (recorded or {}).get("partners"),
+            "recorded_update_bytes": (recorded or {}).get("memory_bytes"),
+            "recording_seconds": (recorded or {}).get("seconds"),
+            "recording_partner_passes":
+                (recorded or {}).get("training_passes"),
+            "reconstructions": recon_coalitions,
+            "recon_batches": recon_batches,
+            "reconstructions_per_s":
+                recon_coalitions / recon_s if recon_s else None,
+            "train_partner_passes": partner_passes,
+            "train_batches": batches - recon_batches,
+        }
     if trust is not None:
         report["trust"] = trust
     if fits:
@@ -229,6 +280,12 @@ def format_report(report: dict) -> str:
         f"  memo        requested={m['requested']}  hits={m['hits']}  "
         f"misses={m['misses']}  hit_rate="
         + (f"{hr:.1%}" if hr is not None else "n/a"))
+    for meth, d in (m.get("per_method") or {}).items():
+        mhr = d.get("hit_rate")
+        lines.append(
+            f"    memo[{meth}]  requested={d['requested']}  "
+            f"hits={d['hits']}  misses={d['misses']}  hit_rate="
+            + (f"{mhr:.1%}" if mhr is not None else "n/a"))
     pw = b["pad_waste_fraction"]
     lines.append(
         f"  batches     n={b['count']}  coalitions={b['coalitions']}  "
@@ -247,12 +304,28 @@ def format_report(report: dict) -> str:
         if r.get("faults_injected"):
             line += f"  faults_injected={r['faults_injected']}"
         lines.append(line)
+    rc = report.get("reconstruction")
+    if rc is not None:
+        mem = rc.get("recorded_update_bytes")
+        rps = rc.get("reconstructions_per_s")
+        lines.append(
+            f"  reconstruct rounds={rc.get('recorded_rounds') or '?'}  "
+            "update_mem="
+            + (f"{mem / 1e6:.1f}MB" if mem is not None else "n/a")
+            + f"  reconstructions={rc.get('reconstructions', 0)}  recons/s="
+            + (f"{rps:.1f}" if rps is not None else "n/a")
+            + f"  passes train/eval={rc.get('train_partner_passes', 0)}/0"
+            + f"  batches train/eval={rc.get('train_batches', 0)}"
+              f"/{rc.get('recon_batches', 0)}")
     t = report.get("trust")
     if t is not None:
-        # seed-ensemble sweeps only: the answer-trust view — how wide the
-        # per-partner CIs are and how stable the ranking is across seeds
+        # the answer-trust view — how wide the per-partner CIs are and how
+        # stable the ranking is. `source` tells seed volatility
+        # (seed_ensemble) from one run's sampling noise (mc_blocks, the
+        # retrain-free estimators); pre-source rows render without it.
         line = (f"  trust       ensemble={t.get('ensemble', '?')}  "
-                f"kendall_tau="
+                + (f"source={t['source']}  " if t.get("source") else "")
+                + f"kendall_tau="
                 + (f"{t['kendall_tau']:.3f}"
                    if t.get("kendall_tau") is not None else "n/a"))
         mean = t.get("mean") or []

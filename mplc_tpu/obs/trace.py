@@ -167,6 +167,17 @@ def start_span(name: str, **attrs) -> Span:
     return Span(name, attrs)
 
 
+def active_span(name: str) -> "Span | None":
+    """Innermost OPEN span with `name` on this thread, or None. Lets deep
+    engine code attribute metrics to the caller that drove it (e.g. the
+    estimator method named by the enclosing `contributivity` span) without
+    threading a parameter through every call layer."""
+    for sp in reversed(_stack()):
+        if sp.name == name:
+            return sp
+    return None
+
+
 def event(name: str, dur: float = 0.0, **attrs) -> None:
     """Emit a point-in-time (or externally timed) record without opening a
     span — e.g. a compile whose duration was measured by the caller."""

@@ -245,3 +245,62 @@ def set_log_file(path: Path):
     debug_h.setFormatter(fmt)
     root.addHandler(info_h)
     root.addHandler(debug_h)
+
+
+_COMPILE_CACHE_CONFIGURED = {"dir": None}
+
+
+def enable_compile_cache_from_env() -> str | None:
+    """Point JAX's persistent compilation cache at
+    `MPLC_TPU_COMPILE_CACHE_DIR` (constants.COMPILE_CACHE_DIR_ENV) when
+    set — the first step of the ROADMAP "program bank" item: every
+    compiled slot-pipeline/reconstruction program is persisted, so a
+    repeated sweep or a service restart pays zero residual compile.
+
+    Returns the configured directory, or None when the knob is unset or
+    configuration failed (a bad path warns instead of killing the run —
+    the sweep still works, it just recompiles). Idempotent: repeated
+    calls with an unchanged env are free."""
+    import os
+    path = os.environ.get(constants.COMPILE_CACHE_DIR_ENV)
+    if not path:
+        return None
+    if _COMPILE_CACHE_CONFIGURED["dir"] == path:
+        return path
+    try:
+        import jax
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache even the small/fast programs: the point is a byte-exact
+        # program bank, and tiny eval executables recompile too
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        try:
+            # JAX latches a disabled cache at the process's FIRST compile;
+            # a dir configured after any prior jit (e.g. an engine built
+            # mid-session) is silently ignored unless the cache module is
+            # reset. Best-effort: private API, absent versions just rely
+            # on being configured early.
+            from jax._src import compilation_cache
+            compilation_cache.reset_cache()
+        except Exception:
+            pass
+        _COMPILE_CACHE_CONFIGURED["dir"] = path
+        return path
+    except Exception as e:
+        import warnings
+        warnings.warn(f"{constants.COMPILE_CACHE_DIR_ENV}={path!r} could "
+                      f"not be configured ({e}); persistent compile cache "
+                      "disabled", stacklevel=2)
+        return None
+
+
+def compile_cache_entries(path: str | None) -> int | None:
+    """Number of persisted executables under a compile-cache dir (None
+    when the dir is unset/missing) — the bench sidecar's cache-hit
+    provenance: a run whose entry count didn't grow was served entirely
+    from the bank."""
+    import os
+    if not path or not os.path.isdir(path):
+        return None
+    return sum(len(files) for _, _, files in os.walk(path))

@@ -171,6 +171,14 @@ def load_telemetry_trust(path):
     return _telemetry_row(path, "trust")
 
 
+def load_telemetry_reconstruction(path):
+    """The retrain-free reconstruction row (GTG-Shapley/SVARM runs):
+    recorded-update memory, reconstructions/s, and the train-vs-eval pass
+    split; retraining-only runs (and pre-reconstruction schemas) load as
+    {}."""
+    return _telemetry_row(path, "reconstruction")
+
+
 def parse_batch_times(log_path):
     """Per-slot-size batch durations (s), from either input kind:
 
@@ -378,14 +386,63 @@ def main():
                   "its batch times mix recovery overhead (and possibly the "
                   "CPU rung) into the device schedule; prefer a clean "
                   "sidecar for projection")
+        rc = load_telemetry_reconstruction(args.telemetry)
+        if rc.get("reconstructions") or rc.get("recording_partner_passes"):
+            mem = rc.get("recorded_update_bytes")
+            rps = rc.get("reconstructions_per_s")
+            # train_partner_passes is the run's GLOBAL training total: in
+            # a mixed run (e.g. exact Shapley + GTG) it includes the
+            # retraining estimators' passes, so the recording run's own
+            # cost is reported from its dedicated field
+            rec_p = rc.get("recording_partner_passes") or 0
+            tot_p = rc.get("train_partner_passes") or 0
+            passes = f" training_passes={rec_p} (recording run)"
+            if tot_p > rec_p:
+                passes += (f" + {tot_p - rec_p} from retraining "
+                           "estimators in the same run")
+            print("measured reconstruction: "
+                  f"rounds={rc.get('recorded_rounds') or '?'} "
+                  "update_mem="
+                  + (f"{mem / 1e6:.1f}MB" if mem is not None else "n/a")
+                  + f" reconstructions={rc.get('reconstructions', 0)}"
+                  + " recons/s="
+                  + (f"{rps:.1f}" if rps is not None else "n/a")
+                  + passes + " eval_batches="
+                  + str(rc.get('recon_batches', 0)))
+            P = rc.get("recorded_partners")
+            rounds = rc.get("recorded_rounds")
+            if P and rounds:
+                # projected exact-vs-GTG from the recorded pass counters:
+                # the exact sweep trains every coalition (slot execution:
+                # |S| passes per round), GTG trains ONLY the recording
+                # run. Both sides use the MEASURED recording cost as the
+                # rounds basis (rec_p < P x rounds under early stopping;
+                # the projection assumes coalitions stop like the grand
+                # run did) so this line agrees with the measured
+                # training_passes printed above.
+                gtg_passes = rec_p or P * rounds
+                exact_passes = sum(comb(P, k) * k
+                                   for k in range(1, P + 1)) \
+                    * gtg_passes // P
+                print(f"projected exact-vs-GTG at P={P}: exact sweep "
+                      f"~{exact_passes} training partner passes vs GTG "
+                      f"recording {gtg_passes} "
+                      f"({exact_passes / gtg_passes:.0f}x fewer; projected "
+                      "training wall-clock ~= exact band / that factor, "
+                      "plus the eval-only reconstruction time above — "
+                      "reconstruction batches are training-free)")
         t = load_telemetry_trust(args.telemetry)
         if t.get("ensemble"):
-            # seed-ensemble run: the sweep's answer-trust view (absent in
-            # single-seed sidecars and every pre-trust schema — both print
-            # nothing). A K-replica run's batch times cover K x rows per
-            # coalition, which the projection inherits as-is.
+            # the sweep's answer-trust view (absent in single-seed,
+            # trust-free sidecars and every pre-trust schema — both print
+            # nothing). `source` distinguishes a seed-ensemble row (K seed
+            # replicas; batch times cover K x rows per coalition, which
+            # the projection inherits as-is) from a retrain-free MC row
+            # (mc_blocks: pseudo-replicas of ONE run's sample stream).
             tau = t.get("kendall_tau")
-            print(f"measured trust: ensemble={t['ensemble']} kendall_tau="
+            print(f"measured trust: ensemble={t['ensemble']}"
+                  + (f" source={t['source']}" if t.get("source") else "")
+                  + " kendall_tau="
                   + (f"{tau:.3f}" if tau is not None else "n/a")
                   + " — per-partner CIs in the sidecar's report.trust row")
         print()

@@ -1,0 +1,519 @@
+"""Retrain-free estimators: GTG-Shapley reconstruction + SVARM sampling.
+
+The contract under test (contrib/reconstruct.py + the GTG-Shapley/SVARM
+methods in contrib/contributivity.py):
+
+1. **Recording fidelity.** One grand-coalition run with
+   `TrainConfig.record_updates` captures per-round per-partner deltas and
+   weights such that replaying ALL of them reproduces the trained
+   grand-coalition model — v(N) reconstructed == v(N) trained,
+   bit-identical (the reconstruction scan applies exactly the recorded
+   aggregations).
+2. **Zero coalition training passes.** A 10-partner GTG-Shapley run pays
+   training work ONLY for the single recording run:
+   `engine.partner_passes` == P x epochs x minibatches, every other
+   `engine.batch` event is `eval_only` with zero epochs/passes, and the
+   eval batches ride the SAME merged slot buckets as a trained sweep.
+3. **Estimator quality (fixed-seed 4-partner pin).** GTG-Shapley and
+   SVARM scores rank-agree with the exact retrained Shapley values
+   (`shapley.kendall_tau >= 0.8`) and each method's scores land inside
+   its own PR-6-style trust confidence intervals.
+4. **Fault ladder.** Both methods survive MPLC_TPU_FAULT_PLAN
+   transient/OOM injection bit-identically to fault-free runs (the PR-4
+   invariant extends to eval-only reconstruction batches).
+5. **Guards & satellites.** record_updates x 2-D / slot / seq guards
+   fail fast; the MPLC_TPU_COMPILE_CACHE_DIR program bank persists
+   executables (even configured after a prior compile); per-method memo
+   attribution reaches counters and the sweep report.
+
+Estimator *arithmetic* is additionally pinned on analytic games (no
+training at all) by pre-seating `engine._reconstruction` with a stub —
+the documented test seam.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+from helpers import build_scenario, cluster_mlp_dataset
+from mplc_tpu.contrib.contributivity import Contributivity
+from mplc_tpu.contrib.shapley import (kendall_tau, powerset_order,
+                                      shapley_from_characteristic)
+from mplc_tpu.mpl.engine import TrainConfig
+from mplc_tpu.obs import metrics
+from mplc_tpu.obs import trace as obs_trace
+from mplc_tpu.obs.report import format_report, sweep_report
+
+from test_contrib import fake_scenario
+
+
+# ---------------------------------------------------------------------------
+# shared scenarios (module-scoped: one recording run each)
+# ---------------------------------------------------------------------------
+
+def _scenario_4p():
+    """4 partners with a strict quality ordering (one fully glabel-
+    corrupted partner + graded data amounts) so rank agreement is a real
+    assertion, not a tie."""
+    return build_scenario(
+        partners_count=4, amounts_per_partner=[0.05, 0.12, 0.28, 0.55],
+        dataset=cluster_mlp_dataset(n=480, seed=11, scale=1.0),
+        epoch_count=3, minibatch_count=2,
+        samples_split_option=["basic", "random"],
+        corrupted_datasets=[("glabel", 1.0), "not_corrupted",
+                            "not_corrupted", "not_corrupted"])
+
+
+@pytest.fixture(scope="module")
+def scen4():
+    sc = _scenario_4p()
+    c = Contributivity(sc)
+    c.compute_SV()
+    return sc, np.array(c.contributivity_scores)
+
+
+@pytest.fixture(scope="module")
+def gtg10():
+    """One 10-partner GTG-Shapley run with metrics + trace collected —
+    the counter-asserted asymptotic-win evidence, shared by the
+    zero-training-pass, bucket-riding, and report-row tests."""
+    sc = build_scenario(
+        partners_count=10, amounts_per_partner=[0.1] * 10,
+        dataset=cluster_mlp_dataset(n=600, seed=7, scale=1.0),
+        epoch_count=2, minibatch_count=2,
+        samples_split_option=["basic", "random"])
+    metrics.reset()
+    with obs_trace.collect() as records:
+        c = Contributivity(sc)
+        c.GTG_Shapley(sv_accuracy=1.0, min_iter=16, perm_batch=8)
+    return sc, c, list(records), metrics.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# 1. recording fidelity
+# ---------------------------------------------------------------------------
+
+def test_recording_reproduces_grand_coalition():
+    sc = build_scenario(
+        partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+        dataset=cluster_mlp_dataset(n=300, seed=5, scale=1.0),
+        epoch_count=2, minibatch_count=2)
+    c = Contributivity(sc)
+    full = (0, 1, 2)
+    v_trained = float(c.engine.evaluate([full])[0])
+    recon = c._reconstructor()
+    # replaying every recorded round over the full mask applies the same
+    # aggregations the recording run applied, but as a different float
+    # expression (g + sum(w~ * (p - g)) with renormalized weights vs
+    # sum(w * p)) — equal to rounding, not guaranteed bit-equal, so the
+    # accuracy must match to tight tolerance (it is exactly equal on the
+    # CPU-float32 tier in practice)
+    assert abs(float(recon.evaluate([full])[0]) - v_trained) < 1e-6
+    rec = recon.recorded
+    assert rec.partners_count == 3
+    assert rec.rounds == 2 * 2 and rec.epochs_done == 2
+    assert rec.training_passes == 3 * 2 * 2
+    assert rec.memory_bytes > 0
+    import jax
+    for leaf in jax.tree_util.tree_leaves(rec.deltas):
+        assert leaf.shape[:2] == (rec.rounds, 3)
+    assert rec.weights.shape == (rec.rounds, 3)
+    # reconstructed values live in their own memo, never the exact one
+    assert full in recon.values
+    assert len(c.engine.charac_fct_values) == 2  # () and the trained v(N)
+
+
+# ---------------------------------------------------------------------------
+# 2/3. fixed-seed 4-partner regression: rank agreement + trust CIs
+# ---------------------------------------------------------------------------
+
+def _assert_inside_own_ci(scores, trust):
+    lo = np.asarray(trust["ci_low"])
+    hi = np.asarray(trust["ci_high"])
+    assert np.all(scores >= lo - 1e-9) and np.all(scores <= hi + 1e-9)
+
+
+def test_gtg_rank_agreement_and_trust(scen4):
+    sc, exact = scen4
+    c = Contributivity(sc)
+    c.GTG_Shapley(sv_accuracy=1.0, min_iter=800, perm_batch=16,
+                  truncation=0.02)
+    gtg = np.array(c.contributivity_scores)
+    assert kendall_tau(exact, gtg) >= 0.8
+    assert c.trust is not None
+    assert set(c.trust) >= {"ensemble", "mean", "std", "ci_low",
+                            "ci_high", "kendall_tau"}
+    # MC pseudo-replica rows are tagged so they can't impersonate a
+    # seed-ensemble trust row in the report/sidecar
+    assert c.trust["source"] == "mc_blocks"
+    assert c.trust["method"] == "GTG-Shapley"
+    _assert_inside_own_ci(gtg, c.trust)
+
+
+def test_svarm_rank_agreement_and_trust(scen4):
+    sc, exact = scen4
+    c = Contributivity(sc)
+    c.SVARM(budget=640)  # 640 coalitions = 320 (A+, A-) pair draws
+    sv = np.array(c.contributivity_scores)
+    assert kendall_tau(exact, sv) >= 0.8
+    assert c.trust is not None
+    assert c.trust["source"] == "mc_blocks"
+    assert c.trust["method"] == "SVARM"
+    _assert_inside_own_ci(sv, c.trust)
+    # SVARM's strata means converge to the reconstructed game's exact
+    # Shapley — tie the sampler to its own ground truth, not just ranks
+    recon = c._reconstructor()
+    recon.evaluate(list(powerset_order(4)))
+    recon_exact = np.array(shapley_from_characteristic(4, recon.values))
+    assert np.all(np.abs(sv - recon_exact) < 0.15)
+
+
+# ---------------------------------------------------------------------------
+# 4. the asymptotic win, counter-asserted at 10 partners
+# ---------------------------------------------------------------------------
+
+def test_gtg_10p_zero_coalition_training_passes(gtg10):
+    sc, c, records, snap = gtg10
+    P, E, MB = 10, 2, 2
+    passes = snap["counters"].get("engine.partner_passes", 0)
+    # training passes come from the ONE recording run and nothing else:
+    # P x epochs x minibatch partner passes total — vs ~2^P x that for
+    # the exact sweep (the issue's O(2^P x P x epochs) bound)
+    assert passes == P * E * MB
+    assert snap["counters"].get("engine.epochs_trained") == E
+    exact_sweep_passes = sum(
+        __import__("math").comb(P, k) * min(k, 10) for k in range(1, P + 1)
+    ) * E * MB
+    assert passes * 50 < exact_sweep_passes
+    batch_events = [r for r in records if r["name"] == "engine.batch"]
+    recording = [r for r in batch_events if r["attrs"].get("recording")]
+    evals = [r for r in batch_events if r["attrs"].get("eval_only")]
+    assert len(recording) == 1
+    assert recording[0]["attrs"]["partner_passes"] == passes
+    assert len(evals) >= 1
+    assert len(recording) + len(evals) == len(batch_events)
+    for r in evals:
+        assert r["attrs"]["epochs"] == 0
+        assert r["attrs"]["partner_passes"] == 0
+        assert r["attrs"]["samples"] == 0
+    assert snap["counters"].get("engine.reconstructions", 0) >= 1
+
+
+def test_reconstruction_rides_merged_slot_buckets(gtg10):
+    sc, c, records, snap = gtg10
+    eng = sc._charac_engine
+    # every multi-partner eval batch's slot_count is one of the engine's
+    # MERGED bucket widths (the same program family a trained sweep
+    # compiles); singles ride the slot-less singles program (None)
+    merged_widths = {eng._slot_width(k) for k in range(2, 11)}
+    evals = [r for r in records if r["name"] == "engine.batch"
+             and r["attrs"].get("eval_only")]
+    multi_widths = {r["attrs"]["slot_count"] for r in evals
+                    if r["attrs"]["slot_count"] is not None}
+    assert multi_widths and multi_widths <= merged_widths
+
+
+def test_reconstruction_report_row_and_memo_attribution(gtg10):
+    sc, c, records, snap = gtg10
+    rep = sweep_report(records, snap)
+    rc = rep["reconstruction"]
+    assert rc["recorded_partners"] == 10
+    assert rc["recorded_rounds"] == 4
+    assert rc["recorded_update_bytes"] > 0
+    assert rc["recording_partner_passes"] == 40
+    assert rc["train_partner_passes"] == 40       # recording run only
+    assert rc["train_batches"] == 1
+    assert rc["recon_batches"] >= 1
+    assert rc["reconstructions"] >= 1
+    assert rc["reconstructions_per_s"] is None or \
+        rc["reconstructions_per_s"] > 0
+    txt = format_report(rep)
+    assert "reconstruct" in txt and "passes train/eval=40/0" in txt
+    # per-method memo attribution (satellite): counters keyed by the
+    # active estimator method, and a per_method row in the report memo
+    assert "engine.memo_hits[GTG-Shapley]" in snap["counters"]
+    assert "engine.memo_misses[GTG-Shapley]" in snap["counters"]
+    pm = rep["memo"]["per_method"]["GTG-Shapley"]
+    assert pm["requested"] == pm["hits"] + pm["misses"]
+    assert pm["hits"] > 0   # permutation prefixes repeat across rounds
+
+
+def test_per_method_memo_row_schema():
+    # old (method-less) record streams keep the exact old memo schema
+    recs = [{"name": "engine.evaluate", "dur": 0.1,
+             "attrs": {"requested": 4, "missing": 2}}]
+    assert "per_method" not in sweep_report(recs)["memo"]
+    recs[0]["attrs"]["method"] = "SVARM"
+    rep = sweep_report(recs)
+    assert rep["memo"]["per_method"] == {
+        "SVARM": {"requested": 4, "hits": 2, "misses": 2, "hit_rate": 0.5}}
+
+
+# ---------------------------------------------------------------------------
+# 5. fault-injection ladder: recovered == fault-free, bit-identically
+# ---------------------------------------------------------------------------
+
+def _small_scenario():
+    return build_scenario(
+        partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+        dataset=cluster_mlp_dataset(n=240, seed=9, scale=1.0),
+        epoch_count=2, minibatch_count=2)
+
+
+def _run_method(method):
+    sc = _small_scenario()
+    c = Contributivity(sc)
+    if method == "GTG-Shapley":
+        c.GTG_Shapley(sv_accuracy=1.0, min_iter=16, perm_batch=8)
+    else:
+        c.SVARM(budget=48, block=16)
+    return np.array(c.contributivity_scores)
+
+
+@pytest.mark.parametrize("method", ["GTG-Shapley", "SVARM"])
+@pytest.mark.parametrize("plan,expect", [
+    # batch 1 is the recording run's dispatch; batch 2+ are eval batches
+    ("transient@batch1,transient@batch3", "engine.retries"),
+    ("oom@batch2", "engine.cap_halvings"),
+])
+def test_fault_ladder_bit_identical(monkeypatch, method, plan, expect):
+    monkeypatch.delenv("MPLC_TPU_FAULT_PLAN", raising=False)
+    clean = _run_method(method)
+    metrics.reset()
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", plan)
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    faulted = _run_method(method)
+    snap = metrics.snapshot()
+    assert snap["counters"].get("engine.faults_injected", 0) >= 1
+    assert snap["counters"].get(expect, 0) >= 1
+    np.testing.assert_array_equal(clean, faulted)
+
+
+def test_forever_dropped_null_player(monkeypatch):
+    """The engine's exact-null-player rule reaches the reconstructor: an
+    all-dropped coalition scores v = 0 (not the untrained init model's
+    chance accuracy), and a dropped member's zero-weight rows renormalize
+    away bit-identically to the partner-excluded coalition."""
+    monkeypatch.setenv("MPLC_TPU_PARTNER_FAULT_PLAN", "dropout@p0:epoch1")
+    sc = _small_scenario()
+    c = Contributivity(sc)
+    recon = c._reconstructor()
+    v = recon.evaluate([(0,), (0, 1), (1,), (0, 1, 2), (1, 2)])
+    assert v[0] == 0.0
+    assert float(c.engine.evaluate([(0,)])[0]) == 0.0  # engine agrees
+    assert v[1] == v[2]
+    assert v[3] == v[4]
+
+
+def test_seed_ensemble_trust_row_tagged():
+    from mplc_tpu.contrib.shapley import trust_summary
+    t = trust_summary(2, {(): np.zeros(3), (0,): np.full(3, .2),
+                          (1,): np.full(3, .3), (0, 1): np.full(3, .6)})
+    assert t["source"] == "seed_ensemble"
+
+
+def test_cpu_rung_oom_propagates(monkeypatch):
+    """An OOM raised on the terminal CPU rung must PROPAGATE (matching
+    the engine's _run_groups_cpu), not re-enter the degrade ladder and
+    livelock re-dispatching the same width-1 CPU batch forever."""
+    from mplc_tpu import faults
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN",
+                       "oom@batch2,oom@batch3,oom@batch4")
+    monkeypatch.setenv("MPLC_TPU_MAX_CAP_HALVINGS", "1")
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    sc = _small_scenario()
+    c = Contributivity(sc)
+    recon = c._reconstructor()  # ordinal 1 = the recording run
+    assert c.engine._max_cap_halvings == 1
+    # ordinals 2+3: device dispatch OOMs exhaust the 1-rung ladder ->
+    # CPU rung; ordinal 4: the CPU re-dispatch OOMs -> must raise
+    with pytest.raises(Exception) as ei:
+        recon.evaluate([(0, 1), (0, 2), (1, 2), (0, 1, 2)])
+    assert faults.is_oom(ei.value)
+    assert c.engine._cpu_degraded
+
+
+# ---------------------------------------------------------------------------
+# 6. guards: record_updates x slot/seq/2-D fails fast
+# ---------------------------------------------------------------------------
+
+def test_record_updates_config_guards():
+    base = dict(minibatch_count=2, epoch_count=2,
+                gradient_updates_per_pass=2)
+    with pytest.raises(ValueError, match="fedavg"):
+        TrainConfig(approach="seqavg", record_updates=True, **base)
+    with pytest.raises(ValueError, match="slot"):
+        TrainConfig(approach="fedavg", record_updates=True, slot_count=2,
+                    **base)
+    with pytest.raises(ValueError, match="2-D|partner-axis"):
+        TrainConfig(approach="fedavg", record_updates=True,
+                    partner_axis="partners", **base)
+
+
+def test_method_span_not_leaked_on_reconstructor_failure():
+    """A failing _reconstructor() must not leave the 'contributivity'
+    method span open — a leaked span would mis-attribute every later
+    method's memo counters via active_span."""
+    sc = fake_scenario(3, lambda s: 0.5)
+    sc._charac_engine._pipe2d = object()  # trips the 2-D guard
+    c = Contributivity(sc)
+    for call in (c.GTG_Shapley, c.SVARM):
+        with pytest.raises(ValueError, match="2-D"):
+            call()
+        assert obs_trace.active_span("contributivity") is None
+
+
+def test_svarm_env_budget_zero_is_silent_auto(monkeypatch):
+    phi = [0.2, 0.3, 0.5]
+    sc = _analytic(3, lambda s: sum(phi[i] for i in s))
+    monkeypatch.setenv("MPLC_TPU_SVARM_SAMPLES", "0")
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the documented auto value: no warn
+        c = Contributivity(sc)
+        c.SVARM(block=64)
+    # auto budget (128 coalitions): MC-converged, not exact
+    np.testing.assert_allclose(c.contributivity_scores, phi, atol=0.02)
+
+
+def test_record_updates_2d_engine_guard():
+    from mplc_tpu.contrib import reconstruct
+    eng = types.SimpleNamespace(_pipe2d=object())
+    with pytest.raises(ValueError, match="2-D"):
+        reconstruct.record_updates(eng)
+    with pytest.raises(ValueError, match="2-D"):
+        reconstruct.ReconstructionEvaluator(eng)
+
+
+# ---------------------------------------------------------------------------
+# 7. estimator arithmetic on analytic games (no training at all)
+# ---------------------------------------------------------------------------
+
+class _StubRecon:
+    """The documented `engine._reconstruction` test seam: a closed-form
+    reconstructed game."""
+
+    def __init__(self, fn):
+        self.values = {(): 0.0}
+        self._fn = fn
+
+    def evaluate(self, subsets):
+        keys = [tuple(sorted(int(i) for i in s)) for s in subsets]
+        for k in keys:
+            if k not in self.values:
+                self.values[k] = float(self._fn(k))
+        return np.array([self.values[k] for k in keys])
+
+
+def _analytic(n, fn):
+    sc = fake_scenario(n, fn)
+    sc._charac_engine._reconstruction = _StubRecon(fn)
+    return sc
+
+
+def test_gtg_additive_game_is_exact():
+    phi = [0.05, 0.10, 0.25, 0.40]
+    sc = _analytic(4, lambda s: sum(phi[i] for i in s))
+    c = Contributivity(sc)
+    c.GTG_Shapley(sv_accuracy=1.0, min_iter=32, perm_batch=16,
+                  truncation=0.0)
+    # additive game: every permutation's marginal IS the partner value
+    np.testing.assert_allclose(c.contributivity_scores, phi, atol=1e-12)
+
+
+def test_gtg_svarm_converge_on_saturating_game():
+    phi = [0.05, 0.10, 0.25, 0.40]
+    fn = lambda s: min(1.0, 1.3 * sum(phi[i] for i in s))  # noqa: E731
+    table = {(): 0.0}
+    for s in powerset_order(4):
+        table[s] = fn(s)
+    exact = np.array(shapley_from_characteristic(4, table))
+    c = Contributivity(_analytic(4, fn))
+    c.GTG_Shapley(sv_accuracy=1.0, min_iter=400, perm_batch=16,
+                  truncation=0.0)
+    np.testing.assert_allclose(c.contributivity_scores, exact, atol=0.02)
+    c2 = Contributivity(_analytic(4, fn))
+    c2.SVARM(budget=2000)
+    np.testing.assert_allclose(c2.contributivity_scores, exact, atol=0.02)
+
+
+def test_svarm_exact_anchor_strata():
+    # n=2: every stratum is an exact anchor, so SVARM is exact with ANY
+    # budget — phi_i = (v({i}) + v(N) - v({j})) / 2
+    vals = {(0,): 0.3, (1,): 0.5, (0, 1): 0.9}
+    sc = _analytic(2, lambda s: vals[tuple(sorted(s))])
+    c = Contributivity(sc)
+    c.SVARM(budget=4, block=2)
+    np.testing.assert_allclose(c.contributivity_scores,
+                               [(0.3 + 0.9 - 0.5) / 2,
+                                (0.5 + 0.9 - 0.3) / 2], atol=1e-12)
+
+
+def test_gtg_env_truncation_knob(monkeypatch):
+    phi = [0.2, 0.3, 0.5]
+    sc = _analytic(3, lambda s: sum(phi[i] for i in s))
+    monkeypatch.setenv("MPLC_TPU_GTG_TRUNCATION", "999")
+    c = Contributivity(sc)
+    c.GTG_Shapley(sv_accuracy=1.0, min_iter=8, perm_batch=8)
+    # a huge threshold truncates EVERY position: all marginals collapse
+    # to zero except none get past |v(N) - 0| >= 999 — scores all zero
+    np.testing.assert_allclose(c.contributivity_scores, 0.0, atol=1e-12)
+
+
+def test_svarm_env_budget_knob(monkeypatch):
+    calls = []
+    phi = [0.2, 0.3, 0.5]
+    sc = _analytic(3, lambda s: sum(phi[i] for i in s))
+    recon = sc._charac_engine._reconstruction
+    orig = recon.evaluate
+    recon.evaluate = lambda s: (calls.append(len(s)), orig(s))[1]
+    monkeypatch.setenv("MPLC_TPU_SVARM_SAMPLES", "16")
+    c = Contributivity(sc)
+    c.SVARM(block=8)
+    # anchors (1 + 3 + 3) + warm-up (6) + 2 blocks of 8 pair-draws:
+    # the env budget bounds the sampled phase
+    assert sum(calls) <= 1 + 6 + 6 + 2 * 16 + 4
+
+
+# ---------------------------------------------------------------------------
+# 8. persistent compile cache (MPLC_TPU_COMPILE_CACHE_DIR program bank)
+# ---------------------------------------------------------------------------
+
+def test_compile_cache_env(tmp_path, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from mplc_tpu import utils
+    bank = tmp_path / "bank"
+    monkeypatch.setenv("MPLC_TPU_COMPILE_CACHE_DIR", str(bank))
+    try:
+        assert utils.enable_compile_cache_from_env() == str(bank)
+        # idempotent re-entry with an unchanged env
+        assert utils.enable_compile_cache_from_env() == str(bank)
+        # the bank captures programs even though this test process has
+        # compiled plenty before the knob was read (the late-config case)
+        f = jax.jit(lambda x: x * 2.5 + jnp.sin(x) * jnp.cos(x))
+        f(jnp.arange(11.0)).block_until_ready()
+        assert utils.compile_cache_entries(str(bank)) >= 1
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        utils._COMPILE_CACHE_CONFIGURED["dir"] = None
+    assert utils.compile_cache_entries(None) is None
+    assert utils.compile_cache_entries(str(tmp_path / "missing")) is None
+
+
+def test_compile_cache_bad_path_warns(tmp_path, monkeypatch):
+    from mplc_tpu import utils
+    blocker = tmp_path / "file"
+    blocker.write_text("x")
+    monkeypatch.setenv("MPLC_TPU_COMPILE_CACHE_DIR",
+                       str(blocker / "nested"))
+    with pytest.warns(UserWarning, match="persistent compile cache"):
+        assert utils.enable_compile_cache_from_env() is None
+
+
+def test_compile_cache_unset_noop(monkeypatch):
+    from mplc_tpu import utils
+    monkeypatch.delenv("MPLC_TPU_COMPILE_CACHE_DIR", raising=False)
+    assert utils.enable_compile_cache_from_env() is None
