@@ -184,6 +184,27 @@ RETRY_BACKOFF_CAP_SEC = 30.0  # bound on a single backoff sleep
 PARTNER_FAULT_PLAN_ENV = "MPLC_TPU_PARTNER_FAULT_PLAN"
 SEED_ENSEMBLE_ENV = "MPLC_TPU_SEED_ENSEMBLE"
 
+# Buffer donation (mpl/engine.py jit properties + the program bank): with
+# the knob at its default (on), the trainer's state-carrying jits declare
+# donate_argnums on the TrainState argument, so the previous epoch-chunk's
+# params/optimizer buffers are donated into each step instead of coexisting
+# with the new state — roughly halving param-side HBM per in-flight batch
+# and raising the HBM-derived coalition-cap autotune. Donation NEVER
+# changes v(S) (bit-identity is equality-tested, tests/test_donation.py);
+# MPLC_TPU_DONATE_BUFFERS=0 opts out (e.g. to bisect an aliasing bug in a
+# new jaxlib). Read at jit-construction time, keyed into the per-trainer
+# jit cache, so engines built after a toggle see the new policy.
+DONATE_BUFFERS_ENV = "MPLC_TPU_DONATE_BUFFERS"
+
+# Program bank (contrib/bank.py): AOT-lower + compile every slot program
+# ahead of its first dispatch, overlap compilation of bucket k+1 with
+# bucket k's execution on a background thread, and persist a manifest of
+# compiled program keys next to the XLA persistent cache so a repeated
+# sweep (or bench warm-up) can prove the bank already holds every program
+# it needs. MPLC_TPU_PROGRAM_BANK=0 disables (every program then compiles
+# inline at first dispatch, the pre-bank behavior).
+PROGRAM_BANK_ENV = "MPLC_TPU_PROGRAM_BANK"
+
 # Persistent XLA compilation cache (utils.enable_compile_cache_from_env):
 # when set, every compiled program — the slot-pipeline trainers, the
 # reconstruction eval programs, bench warm-up — is persisted to this
@@ -234,6 +255,11 @@ ENV_KNOBS = {
     # number is not comparable to a cache-warmed run — and the CPU child
     # configures its own cache dir
     "MPLC_TPU_COMPILE_CACHE_DIR": "workload",
+    # workload, not sidecar: donation changes the HBM footprint and
+    # therefore the autotuned batch cap (bucket widths), and the bank
+    # changes what a measured run pays in compile time
+    "MPLC_TPU_DONATE_BUFFERS": "workload",
+    "MPLC_TPU_PROGRAM_BANK": "workload",
     "MPLC_TPU_EVAL_CHUNK": "workload",
     "MPLC_TPU_GTG_TRUNCATION": "workload",
     "MPLC_TPU_SVARM_SAMPLES": "workload",

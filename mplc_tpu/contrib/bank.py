@@ -1,0 +1,375 @@
+"""The program bank: AOT-compiled slot programs with compile/execute overlap.
+
+Before this module, every slot program compiled lazily inside its first
+dispatch — the sweep's warm-up was a SERIAL phase (the bench pays ~15 min
+of slot-pipeline compiles before timing), and a cap change mid-sweep
+stalled the device behind a foreground compile. The bank restructures
+compilation three ways:
+
+  1. **AOT**: every (slots, width) program the sweep will run is lowered
+     and compiled ahead of its first dispatch via the jit AOT path
+     (`jit.lower(...).compile()`), keyed by the engine's cache fingerprint
+     x (slot_count, width, donation signature, epoch count, device count,
+     backend) — the full identity of the executable. The compiled
+     executables are held in a PROCESS-GLOBAL store, so a second engine on
+     the same game (the bench's timed engine after its warm engine, a
+     resumed sweep, a second tenant of the same scenario shape) executes
+     straight from the bank with zero compiles.
+  2. **Overlap**: `prefetch(plan)` hands the sweep's whole bucket schedule
+     to a background thread that compiles bucket k+1's programs while
+     bucket k executes on the device. Only the FIRST bucket's compile
+     remains serial (`acquire` compiles it in the caller's thread); the
+     rest land as `bank.compile` events with `overlapped=True`, which the
+     sweep report separates from the serial compile row.
+  3. **Persistence**: compiles run under JAX's persistent compilation
+     cache (MPLC_TPU_COMPILE_CACHE_DIR, utils.enable_compile_cache_from_env),
+     so the executables serialize to disk as a side effect — and the bank
+     additionally writes a MANIFEST of compiled program keys next to the
+     cache entries, turning the cache dir into a queryable program bank:
+     `holds_persistent(plan)` proves a fresh process already has every
+     program a sweep needs (bench.py skips its compile-prime warm-up loop
+     on that proof and records `warmup_skipped` provenance).
+
+Execution contract: a banked bundle is the SAME jit, lowered with the same
+donation signature and the same input shardings the engine dispatches with
+— bit-identity between banked and freshly-jit-compiled sweeps is an
+invariant (equality-tested in tests/test_program_bank.py, including under
+injected transient/OOM faults). A bundle is only served for the exact
+width it was lowered at; the OOM ladder's re-bucketed widths fall back to
+the ordinary jit path (and may bank their own width on a later call).
+MPLC_TPU_PROGRAM_BANK=0 disables the bank entirely.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+
+import jax
+
+from .. import constants
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+logger = logging.getLogger("mplc_tpu")
+
+MANIFEST_NAME = "mplc_program_bank.json"
+
+# Process-global store: key -> bundle dict ({"init","run","fin"} Compiled)
+# or the Exception that killed its compile (acquire then falls back to the
+# jit path instead of retrying a known-bad lowering every bucket).
+# FIFO-bounded: a long-lived multi-tenant process banks a bundle per
+# (game x shape x width), and loaded executables hold device program
+# memory — evicting the oldest beyond the bound only costs a recompile
+# (served from the persistent cache when configured), never correctness.
+_PROGRAMS: dict = {}
+_MAX_PROGRAMS = 256
+# key -> threading.Event for compiles in flight (foreground or background);
+# exactly one thread owns a key's compile, everyone else waits on the event.
+_INFLIGHT: dict = {}
+_LOCK = threading.Lock()
+_MANIFEST_LOCK = threading.Lock()
+
+
+def bank_enabled() -> bool:
+    return os.environ.get(constants.PROGRAM_BANK_ENV, "1") != "0"
+
+
+def reset_bank() -> None:
+    """Drop every banked executable (tests; never needed in production —
+    the store is keyed by the full program identity)."""
+    with _LOCK:
+        _PROGRAMS.clear()
+        for ev in _INFLIGHT.values():
+            ev.set()
+        _INFLIGHT.clear()
+
+
+def manifest_dir() -> "str | None":
+    """Where the persistent manifest lives: the configured compile-cache
+    dir (env knob first, then whatever the process pointed jax's
+    persistent cache at). None = no persistence, the bank is
+    process-local only."""
+    path = os.environ.get(constants.COMPILE_CACHE_DIR_ENV)
+    if path:
+        return path
+    try:
+        path = jax.config.jax_compilation_cache_dir
+        return path or None
+    except Exception:
+        return None
+
+
+class ProgramBank:
+    """Per-engine view onto the process-global AOT program store."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._digest_cache = None
+
+    # -- program identity ------------------------------------------------
+
+    def _engine_digest(self) -> str:
+        if self._digest_cache is None:
+            fp = json.dumps(self.engine._fingerprint(), sort_keys=True,
+                            default=str)
+            self._digest_cache = hashlib.sha256(fp.encode()).hexdigest()[:16]
+        return self._digest_cache
+
+    @staticmethod
+    def _pipe_donates(pipe) -> bool:
+        """The donation signature of the executables this pipe would lower
+        — the policy BOUND into its jits at construction, not the live env
+        (an env flip between engines must not let a donating executable be
+        served under a non-donating key, or vice versa: the caller's
+        nb_epochs_done copy depends on it)."""
+        return bool(getattr(pipe, "_fin_donates", False))
+
+    def program_key(self, pipe, slot_count, width) -> str:
+        """The executable's full identity: the engine fingerprint (game +
+        data + trainer config as far as v(S) sees it) x the per-program
+        shape (TrainConfig repr covers slot_count/approach/record flags,
+        plus the batch width) x the donation signature x the topology.
+        Two programs with equal keys are interchangeable executables."""
+        eng = self.engine
+        cfg = pipe.trainer.cfg
+        n_dev = eng._sharding.num_devices if eng._sharding else 1
+        raw = json.dumps([
+            self._engine_digest(), repr(cfg), pipe.partners_count,
+            slot_count, int(width), self._pipe_donates(pipe),
+            n_dev, jax.default_backend()])
+        return hashlib.sha256(raw.encode()).hexdigest()[:24]
+
+    # -- lowering --------------------------------------------------------
+
+    def _arg_sds(self, pipe, slot_count, width):
+        """ShapeDtypeStructs for the per-batch arguments, carrying the
+        sharding the engine dispatches with (device_put onto the coal
+        mesh), so the compiled executable accepts the real batches."""
+        import jax.numpy as jnp
+        eng = self.engine
+        sh = eng._sharding.batch_sharding if eng._sharding else None
+
+        def sds(shape, dtype):
+            if sh is not None:
+                return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+        rngs = sds((width, 2), jnp.uint32)
+        if slot_count is not None:
+            masks = sds((width, slot_count), jnp.int32)
+        else:
+            masks = sds((width, eng.partners_count), jnp.float32)
+        return masks, rngs
+
+    def _compile_bundle(self, pipe, slot_count, width) -> dict:
+        """AOT-lower + compile the pipeline's init -> epoch-chunk ->
+        finalize for one (slots, width) program. State shardings chain
+        through `Compiled.output_shardings`, so the three executables
+        compose exactly like the jit path's dispatch."""
+        eng = self.engine
+        cfg = pipe.trainer.cfg
+        masks_sds, rngs_sds = self._arg_sds(pipe, slot_count, width)
+
+        def state_sds_like(shapes, shardings):
+            return jax.tree_util.tree_map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                                   sharding=sh),
+                shapes, shardings)
+
+        init_c = pipe._init.lower(rngs_sds, pipe.partners_count).compile()
+        # partners_count must stay a static python int under eval_shape
+        # (init_state builds shapes from it), so close over it instead of
+        # passing it as a traced argument
+        state_shapes = jax.eval_shape(
+            jax.vmap(lambda r: pipe.trainer.init_state(
+                r, pipe.partners_count)),
+            rngs_sds)
+        run_c = pipe._run.lower(
+            state_sds_like(state_shapes, init_c.output_shardings),
+            eng.stacked, eng.val, masks_sds, rngs_sds,
+            cfg.epoch_count).compile()
+        fin_c = pipe._fin.lower(
+            state_sds_like(state_shapes, run_c.output_shardings),
+            eng.test).compile()
+        return {"init": init_c, "run": run_c, "fin": fin_c}
+
+    def _do_compile(self, key, pipe, slot_count, width,
+                    overlapped: bool) -> None:
+        """Compile under an exclusive in-flight claim and publish the
+        result (bundle or the failure) to the global store."""
+        t0 = time.perf_counter()
+        entry = None
+        ok = False
+        try:
+            try:
+                entry = self._compile_bundle(pipe, slot_count, width)
+                ok = True
+            except Exception as e:  # a bad lowering must not kill the sweep
+                logger.warning(
+                    "program-bank compile failed for (slots=%s, width=%s) — "
+                    "falling back to inline jit compilation: %s",
+                    slot_count, width, e)
+                entry = e
+        finally:
+            # publish UNCONDITIONALLY — a waiter blocked on the in-flight
+            # event must never hang because the compiling thread died
+            with _LOCK:
+                _PROGRAMS[key] = (entry if entry is not None
+                                  else RuntimeError("bank compile aborted"))
+                ev = _INFLIGHT.pop(key, None)
+                # FIFO bound on the global store (oldest first; dicts are
+                # insertion-ordered). In-flight users keep their bundle
+                # alive through their own reference.
+                while len(_PROGRAMS) > _MAX_PROGRAMS:
+                    _PROGRAMS.pop(next(iter(_PROGRAMS)))
+            if ev is not None:
+                ev.set()
+        dur = time.perf_counter() - t0
+        if ok:
+            obs_metrics.counter("bank.compiles").inc()
+            obs_metrics.counter("bank.compile_seconds").inc(dur)
+            if overlapped:
+                obs_metrics.counter("bank.compiles_overlapped").inc()
+            obs_trace.event(
+                "bank.compile", dur=dur, slot_count=slot_count,
+                width=int(width), overlapped=overlapped,
+                donation=self._pipe_donates(pipe), programs=3)
+            self._record_manifest(key)
+
+    def _claim(self, key):
+        """(entry, event, owner): the published entry if any, else the
+        in-flight event to wait on, else ownership of the compile."""
+        with _LOCK:
+            entry = _PROGRAMS.get(key)
+            if entry is not None:
+                return entry, None, False
+            ev = _INFLIGHT.get(key)
+            if ev is not None:
+                return None, ev, False
+            _INFLIGHT[key] = threading.Event()
+            return None, None, True
+
+    # -- the two engine-facing operations --------------------------------
+
+    def acquire(self, pipe, slot_count, width):
+        """The executable bundle for one bucket, compiling in the CALLER's
+        thread when the background prefetch hasn't produced it (the first
+        bucket's compile stays serial by design). Returns None — jit path
+        — when the bank is disabled, the pipe needs mid-run host decisions
+        (early-stopping chunk loop), or the program's compile failed."""
+        if not bank_enabled() or not pipe.dispatches_async:
+            return None
+        key = self.program_key(pipe, slot_count, width)
+        entry, ev, owner = self._claim(key)
+        if owner:
+            self._do_compile(key, pipe, slot_count, width, overlapped=False)
+        elif ev is not None:
+            # a background (or concurrent) compile owns the key. This
+            # wait is SERIAL wall-clock: the single worker drains its
+            # queue in order, so on a cold bank where execution outruns
+            # compilation the stall can span several programs' compiles
+            # — emit it as a bank.wait span so the sweep report books it
+            # as serial compile stall instead of letting the worker's
+            # overlapped=True events claim the time never blocked
+            # anyone. The timeout is a belt-and-braces bound (the owner
+            # publishes in a finally); on expiry the caller just takes
+            # the inline jit path.
+            with obs_trace.span("bank.wait", slot_count=slot_count,
+                                width=int(width)):
+                ev.wait(timeout=600)
+        entry = _PROGRAMS.get(key)
+        if not owner and ev is None and isinstance(entry, dict):
+            # a true bank hit: served from the store with no compile and
+            # no wait (failed-compile tombstones are NOT hits — the
+            # sweep is actually compiling inline for that program)
+            obs_metrics.counter("bank.hits").inc()
+        return entry if isinstance(entry, dict) else None
+
+    def prefetch(self, plan) -> None:
+        """Background-compile every bucket AFTER the first: while bucket k
+        executes, bucket k+1's programs compile on this worker, so the
+        sweep's compile phase collapses to the first bucket only. `plan`
+        is [(pipe, slot_count, width)] in dispatch order (the engine's
+        evaluate() bucket schedule)."""
+        if not bank_enabled():
+            return
+        work = []
+        for pipe, slot_count, width in plan[1:]:
+            if not pipe.dispatches_async:
+                continue
+            key = self.program_key(pipe, slot_count, width)
+            with _LOCK:
+                if key in _PROGRAMS or key in _INFLIGHT:
+                    continue
+                _INFLIGHT[key] = threading.Event()
+            work.append((key, pipe, slot_count, width))
+        if not work:
+            return
+
+        def worker():
+            for key, pipe, slot_count, width in work:
+                self._do_compile(key, pipe, slot_count, width,
+                                 overlapped=True)
+
+        threading.Thread(target=worker, daemon=True,
+                         name="mplc-program-bank").start()
+
+    # -- persistence (the manifest that makes the cache dir a bank) ------
+
+    def persistent_keys(self) -> set:
+        d = manifest_dir()
+        if not d:
+            return set()
+        try:
+            with open(os.path.join(d, MANIFEST_NAME)) as f:
+                return set(json.load(f).get("programs", []))
+        except (OSError, ValueError):
+            return set()
+
+    def _record_manifest(self, key: str) -> None:
+        """Append a compiled program's key to the cache-dir manifest
+        (atomic replace; lost manifests only cost a warm-up, never
+        correctness — the XLA cache itself is content-addressed)."""
+        d = manifest_dir()
+        if not d:
+            return
+        with _MANIFEST_LOCK:
+            keys = self.persistent_keys()
+            if key in keys:
+                return
+            keys.add(key)
+            path = os.path.join(d, MANIFEST_NAME)
+            tmp = f"{path}.tmp"
+            try:
+                os.makedirs(d, exist_ok=True)
+                with open(tmp, "w") as f:
+                    json.dump({"programs": sorted(keys)}, f)
+                os.replace(tmp, path)
+            except OSError as e:
+                logger.warning("program-bank manifest write failed: %s", e)
+
+    def holds_persistent(self, plan) -> bool:
+        """True when the persistent manifest proves every program in
+        `plan` was compiled (into the persistent compile cache) by some
+        earlier run — the bench warm-up's skip condition. A plan whose
+        every entry needs mid-run host decisions (non-async pipes, e.g.
+        early stopping past the patience bound) has NO bankable programs,
+        so the answer is False — the warm-up must still prime those
+        inline-jit compiles."""
+        if not bank_enabled() or not plan:
+            return False
+        keys = self.persistent_keys()
+        if not keys:
+            return False
+        bankable = [(pipe, slot_count, width)
+                    for pipe, slot_count, width in plan
+                    if pipe.dispatches_async]
+        if not bankable:
+            return False
+        return all(self.program_key(pipe, slot_count, width) in keys
+                   for pipe, slot_count, width in bankable)

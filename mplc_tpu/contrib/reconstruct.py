@@ -131,7 +131,13 @@ def record_updates(engine) -> RecordedRun:
                             coalitions=1, padding=0, recording=True):
             engine._faults.check("dispatch", ordinal)
             state = trainer.init_state(rng, P)
-            init_params = state.params
+            # COPY the init params out of the state: the epoch-chunk jit
+            # donates its state argument (mpl/engine.py buffer donation),
+            # so the state's own param buffers are consumed by the first
+            # chunk — but the recorded stream replays deltas from exactly
+            # these initial params. The copy is enqueued before the
+            # donating call, so ordering is safe.
+            init_params = jax.tree_util.tree_map(jnp.copy, state.params)
             if cfg.is_early_stopping:
                 chunk = max(1, min(cfg.patience, cfg.epoch_count))
                 epochs_left = cfg.epoch_count
@@ -237,7 +243,16 @@ class ReconstructionEvaluator:
 
                 return jax.vmap(one)(masks)
 
-            self._fn = jax.jit(batch_eval)
+            # donate the per-batch mask buffer (argument 0) into the
+            # fused reconstruct+eval scan; the recorded stream
+            # (init_params/deltas/weights) and the test set are REUSED
+            # across every batch and must never be donated. Retry safety:
+            # the dispatch closure re-materializes masks from the host
+            # array on every invocation (`_run_batch`).
+            from ..mpl.engine import buffer_donation_enabled
+            self._fn = jax.jit(
+                batch_eval,
+                donate_argnums=(0,) if buffer_donation_enabled() else ())
         return self._fn
 
     def _apply(self, masks: jax.Array) -> jax.Array:

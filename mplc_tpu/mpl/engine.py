@@ -64,6 +64,27 @@ from ..ops.metrics import masked_loss_and_metrics
 APPROACH_NAMES = ("fedavg", "seq-pure", "seq-with-final-agg", "seqavg", "lflip", "single")
 
 
+def buffer_donation_enabled() -> bool:
+    """MPLC_TPU_DONATE_BUFFERS (default on): the trainer's state-carrying
+    jits donate their TrainState argument, so each epoch chunk's output
+    state reuses the input state's buffers instead of coexisting with them
+    — roughly half the param-side HBM per in-flight batch. Donation is an
+    aliasing contract, never a numerics change: donated and non-donated
+    runs are bit-identical (tests/test_donation.py). Read at
+    jit-construction time and keyed into the per-trainer jit cache, so
+    toggling the env between engine constructions takes effect.
+
+    Callers holding a donated state MUST treat it as consumed: rebind
+    (`state = run(state, ...)`) and copy any leaf needed afterwards BEFORE
+    the donating call (the engine copies `nb_epochs_done` ahead of the
+    donating finalize; contrib/reconstruct.py copies the init params ahead
+    of the recording loop). On a failed dispatch the donated buffers are
+    dead — every retry path re-materializes its inputs from host arrays
+    before re-dispatching (contrib/engine.py dispatch closures)."""
+    import os
+    return os.environ.get(constants.DONATE_BUFFERS_ENV, "1") != "0"
+
+
 class _CompileTimedFn:
     """Transparent wrapper around a jitted callable that records compile
     events: when a call grows the jit's executable cache (a new program
@@ -303,16 +324,33 @@ class MplTrainer:
             cls._instances[key] = inst
         return inst
 
+    @staticmethod
+    def _donate_state():
+        """donate_argnums for the state-carrying jits below under the
+        donation policy: argument 0 is always the TrainState, the only
+        state-sized input that is dead after the call at every call site.
+        The data/eval-set/mask/rng arguments are NEVER donated — they are
+        reused across batches (stacked/val/test live for the whole sweep)
+        or across chunk iterations (masks/rngs in the early-stopping
+        loop)."""
+        return (0,) if buffer_donation_enabled() else ()
+
     @property
     def jit_epoch_chunk(self):
-        if "epoch_chunk" not in self._jits:
-            self._jits["epoch_chunk"] = _CompileTimedFn(jax.jit(
-                self.epoch_chunk, static_argnames=("n_epochs",)), "epoch_chunk")
-        return self._jits["epoch_chunk"]
+        don = buffer_donation_enabled()
+        key = ("epoch_chunk", don)
+        if key not in self._jits:
+            self._jits[key] = _CompileTimedFn(jax.jit(
+                self.epoch_chunk, static_argnames=("n_epochs",),
+                donate_argnums=self._donate_state()), "epoch_chunk")
+        return self._jits[key]
 
     @property
     def jit_finalize(self):
         if "finalize" not in self._jits:
+            # no-donation by policy: the fit driver (mpl/approaches.py)
+            # and the sharding tests read state.params / histories AFTER
+            # finalize — the state must survive this call
             self._jits["finalize"] = _CompileTimedFn(
                 jax.jit(self.finalize), "finalize")
         return self._jits["finalize"]
@@ -320,6 +358,8 @@ class MplTrainer:
     @property
     def jit_evaluate(self):
         if "evaluate" not in self._jits:
+            # no-donation by policy: callers (PVRL's reward eval) pass the
+            # LIVE carried params, which train on in the next epoch
             self._jits["evaluate"] = _CompileTimedFn(
                 jax.jit(self.evaluate), "evaluate")
         return self._jits["evaluate"]
@@ -327,6 +367,9 @@ class MplTrainer:
     @property
     def jit_batched_init(self):
         if "binit" not in self._jits:
+            # no-donation by policy: the only array input is the per-
+            # coalition rng batch, which the caller passes again to the
+            # epoch chunk — donating it would kill the training streams
             self._jits["binit"] = _CompileTimedFn(jax.jit(
                 jax.vmap(self.init_state, in_axes=(0, None)),
                 static_argnums=(1,)), "batched_init")
@@ -334,19 +377,29 @@ class MplTrainer:
 
     @property
     def jit_batched_epoch_chunk(self):
-        if "brun" not in self._jits:
-            self._jits["brun"] = _CompileTimedFn(jax.jit(
+        don = buffer_donation_enabled()
+        key = ("brun", don)
+        if key not in self._jits:
+            self._jits[key] = _CompileTimedFn(jax.jit(
                 jax.vmap(self.epoch_chunk, in_axes=(0, None, None, 0, 0, None)),
-                static_argnames=("n_epochs",)), "batched_epoch_chunk")
-        return self._jits["brun"]
+                static_argnames=("n_epochs",),
+                donate_argnums=self._donate_state()), "batched_epoch_chunk")
+        return self._jits[key]
 
     @property
     def jit_batched_finalize(self):
-        if "bfin" not in self._jits:
-            self._jits["bfin"] = _CompileTimedFn(
-                jax.jit(jax.vmap(self.finalize, in_axes=(0, None))),
+        don = buffer_donation_enabled()
+        key = ("bfin", don)
+        if key not in self._jits:
+            # donating the batch state into the test eval frees the
+            # batch's params + optimizer buffers the moment scoring
+            # starts; the engine pipeline copies nb_epochs_done out
+            # first (BatchedTrainerPipeline.scores_async)
+            self._jits[key] = _CompileTimedFn(
+                jax.jit(jax.vmap(self.finalize, in_axes=(0, None)),
+                        donate_argnums=self._donate_state()),
                 "batched_finalize")
-        return self._jits["bfin"]
+        return self._jits[key]
 
     # ------------------------------------------------------------------
     # state init

@@ -59,6 +59,9 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     `peak_flops` (the attached fleet's aggregate peak) additionally yields
     `mfu_proxy` = achieved / peak."""
     evaluate_s = prep_s = dispatch_s = harvest_s = compile_s = 0.0
+    compile_overlapped_s = bank_wait_s = 0.0
+    bank_compiles = bank_compiles_overlapped = 0
+    hbm = None
     requested = missing = 0
     compiles: dict = {}
     buckets: dict = {}
@@ -104,6 +107,35 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             c = compiles.setdefault(fn, {"count": 0, "seconds": 0.0})
             c["count"] += 1
             c["seconds"] += dur
+        elif name == "bank.compile":
+            # AOT program-bank compiles: background (overlapped=True) ones
+            # ran CONCURRENTLY with execution and are reported separately
+            # — they never extended the sweep's wall-clock; foreground
+            # ones (the first bucket) are serial compile time like any
+            # jit-inline compile
+            bank_compiles += 1
+            if a.get("overlapped"):
+                bank_compiles_overlapped += 1
+                compile_overlapped_s += dur
+            else:
+                compile_s += dur
+            fn = f"bank[slots={a.get('slot_count')},w={a.get('width')}]"
+            c = compiles.setdefault(fn, {"count": 0, "seconds": 0.0})
+            c["count"] += 1
+            c["seconds"] += dur
+        elif name == "bank.wait":
+            # serial stall behind the background compile worker: wall-
+            # clock that DID block the sweep even though the compile
+            # itself is booked as overlapped (wall vs CPU views of the
+            # same work — kept separate so the compile row stays honest)
+            bank_wait_s += dur
+            compile_s += dur
+        elif name == "engine.hbm":
+            # one snapshot per evaluate() call; the last one wins (like
+            # the trust row) — the per-coalition footprint model and the
+            # donation cap uplift don't change mid-run except down the
+            # OOM ladder, where the latest view is exactly the right one
+            hbm = dict(a)
         elif name == "engine.batch":
             k = (a.get("slot_count"), int(a.get("width", 0)))
             b = buckets.setdefault(k, {"batches": 0, "coalitions": 0,
@@ -194,6 +226,9 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
         "wallclock": {
             "evaluate_s": evaluate_s,
             "compile_s": compile_s,
+            # program-bank compiles that ran on the background thread
+            # while earlier buckets executed — spent CPU, not wall-clock
+            "compile_overlapped_s": compile_overlapped_s,
             "prep_s": prep_s,
             "dispatch_s": dispatch_s,
             "harvest_s": harvest_s,
@@ -228,6 +263,32 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
         "compiles": compiles,
         "estimators": estimators,
     }
+    if bank_compiles or bank_wait_s:
+        report["program_bank"] = {
+            "compiles": bank_compiles,
+            "compiles_overlapped": bank_compiles_overlapped,
+            "overlapped_s": compile_overlapped_s,
+            # wall-clock the sweep spent BLOCKED on the background
+            # worker (already included in wallclock.compile_s)
+            "waited_s": bank_wait_s,
+        }
+    if hbm is not None:
+        # the donation/HBM view: modeled per-coalition footprint, the
+        # buffer-donation saving, and the coalition-cap autotune before
+        # vs after donation (the knob headroom donation buys)
+        report["hbm"] = {
+            "param_bytes": hbm.get("param_bytes"),
+            "slot_count": hbm.get("slot_count"),
+            "donation": hbm.get("donation"),
+            "per_coalition_bytes": hbm.get("per_coalition_bytes"),
+            "donated_bytes_per_coalition":
+                hbm.get("donated_bytes_per_coalition"),
+            "cap_before_donation": hbm.get("cap_before_donation"),
+            "cap_after_donation": hbm.get("cap_after_donation"),
+            "cap_effective": hbm.get("cap_effective"),
+            "hbm_bytes_limit": hbm.get("hbm_bytes_limit"),
+            "peak_in_use_bytes": hbm.get("peak_in_use_bytes"),
+        }
     if per_method:
         report["memo"]["per_method"] = {
             m: {"requested": d["requested"],
@@ -270,11 +331,22 @@ def format_report(report: dict) -> str:
     m = report["memo"]
     b = report["batches"]
     lines = ["sweep report:"]
-    lines.append(
+    line = (
         f"  wall-clock  evaluate={w['evaluate_s']:.2f}s  "
         f"compile={w['compile_s']:.2f}s  prep={w.get('prep_s', 0.0):.2f}s  "
         f"dispatch={w['dispatch_s']:.2f}s  "
         f"harvest={w['harvest_s']:.2f}s")
+    if w.get("compile_overlapped_s"):
+        line += f"  compile_overlapped={w['compile_overlapped_s']:.2f}s"
+    lines.append(line)
+    pb = report.get("program_bank")
+    if pb is not None:
+        line = (f"  bank        compiles={pb['compiles']}  "
+                f"overlapped={pb['compiles_overlapped']} "
+                f"({pb['overlapped_s']:.2f}s off the serial path)")
+        if pb.get("waited_s"):
+            line += f"  waited={pb['waited_s']:.2f}s"
+        lines.append(line)
     hr = m["hit_rate"]
     lines.append(
         f"  memo        requested={m['requested']}  hits={m['hits']}  "
@@ -292,6 +364,23 @@ def format_report(report: dict) -> str:
         f"padding={b['padding']}  pad_waste="
         + (f"{pw:.1%}" if pw is not None else "n/a")
         + f"  epochs={b['epochs_trained']}")
+    h = report.get("hbm")
+    if h is not None:
+        # the donation story in one line: what one coalition costs, what
+        # donation saved, and the cap headroom it bought
+        per = h.get("per_coalition_bytes")
+        saved = h.get("donated_bytes_per_coalition")
+        peak = h.get("peak_in_use_bytes")
+        lines.append(
+            "  hbm         per_coalition="
+            + (f"{per / 1e6:.1f}MB" if per is not None else "n/a")
+            + "  donated_saving="
+            + (f"{saved / 1e6:.1f}MB" if saved else "0")
+            + f"  cap {h.get('cap_before_donation', '?')}"
+              f"->{h.get('cap_after_donation', '?')}"
+              f" (effective {h.get('cap_effective', '?')})"
+            + "  peak_in_use="
+            + (f"{peak / 1e6:.1f}MB" if peak is not None else "n/a"))
     r = report.get("resilience")
     if r is not None:
         # rendered even when all-zero: a clean run should SAY it was clean

@@ -111,6 +111,8 @@ class PartnerShardedTrainer:
         local = partners_count // self.n_shards
         key = ("init", partners_count)
         if key not in self._jits:
+            # no-donation by policy: the rng is the only input and callers
+            # reuse it for the epoch chunk's training streams
             f = shard_map_norep(lambda r: self.trainer.init_state(r, local),
                                 mesh=self.mesh, in_specs=(P(),),
                                 out_specs=self._st)
@@ -120,18 +122,25 @@ class PartnerShardedTrainer:
     def epoch_chunk(self, state: TrainState, stacked: StackedPartners,
                     val: EvalSet, coal_mask: jax.Array, rng: jax.Array,
                     n_epochs: int) -> TrainState:
-        key = ("run", n_epochs)
+        from ..mpl.engine import buffer_donation_enabled
+        don = buffer_donation_enabled()
+        key = ("run", n_epochs, don)
         if key not in self._jits:
             f = shard_map_norep(
                 partial(self.trainer.epoch_chunk, n_epochs=n_epochs),
                 mesh=self.mesh,
                 in_specs=(self._st, self._sp, P(), P(self.axis), P()),
                 out_specs=self._st)
-            self._jits[key] = jax.jit(f)
+            # same donation policy as the trainer's own state-carrying
+            # jits: the input state is dead after every chunk call
+            self._jits[key] = jax.jit(
+                f, donate_argnums=(0,) if don else ())
         return self._jits[key](state, stacked, val, coal_mask, rng)
 
     def finalize(self, state: TrainState, test: EvalSet):
         """Global params are replicated after aggregation; evaluate locally."""
         if "fin" not in self._jits:
+            # no-donation by policy: callers read state.params and the
+            # histories AFTER finalize (tests/test_partner_shard.py)
             self._jits["fin"] = jax.jit(self.trainer.finalize)
         return self._jits["fin"](state, test)

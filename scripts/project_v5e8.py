@@ -179,6 +179,13 @@ def load_telemetry_reconstruction(path):
     return _telemetry_row(path, "reconstruction")
 
 
+def load_telemetry_hbm(path):
+    """The hbm row (buffer donation, PR 8): modeled per-coalition HBM,
+    the donation saving, and the coalition-cap autotune before vs after
+    donation. Pre-donation sidecars load as {}."""
+    return _telemetry_row(path, "hbm")
+
+
 def parse_batch_times(log_path):
     """Per-slot-size batch durations (s), from either input kind:
 
@@ -431,6 +438,22 @@ def main():
                       "training wall-clock ~= exact band / that factor, "
                       "plus the eval-only reconstruction time above — "
                       "reconstruction batches are training-free)")
+        h = load_telemetry_hbm(args.telemetry)
+        if h.get("per_coalition_bytes"):
+            # the donation/HBM view: the projected schedule's bucket
+            # widths assume the measured run's coalition cap — a cap that
+            # rises with donation on (cap_after_donation), so a
+            # donation-off sidecar projects a narrower schedule than the
+            # engine now runs
+            per = h["per_coalition_bytes"]
+            saved = h.get("donated_bytes_per_coalition") or 0
+            print(f"measured hbm: per_coalition={per / 1e6:.1f}MB "
+                  f"donation={'on' if h.get('donation') else 'off'} "
+                  f"saving={saved / 1e6:.1f}MB/coalition "
+                  f"cap {h.get('cap_before_donation', '?')}->"
+                  f"{h.get('cap_after_donation', '?')} "
+                  f"(effective {h.get('cap_effective', '?')}) — widths in "
+                  "the schedule below assume the effective cap")
         t = load_telemetry_trust(args.telemetry)
         if t.get("ensemble"):
             # the sweep's answer-trust view (absent in single-seed,

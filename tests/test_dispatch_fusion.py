@@ -172,6 +172,9 @@ def test_merge_mode_compiles_fewer_programs_and_pads_less(monkeypatch):
 
     monkeypatch.setattr(BatchedTrainerPipeline, "scores_async",
                         fake_scores_async)
+    # training is stubbed out, so AOT program-bank compiles would be pure
+    # waste here (the bank compiles REAL executables the stub never runs)
+    monkeypatch.setenv("MPLC_TPU_PROGRAM_BANK", "0")
     monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "2")
     monkeypatch.delenv("MPLC_TPU_SLOT_POW2", raising=False)
     monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)

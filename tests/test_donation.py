@@ -1,0 +1,246 @@
+"""Buffer donation (ISSUE 8): the trainer's state-carrying jits declare
+`donate_argnums`, halving param-side HBM per in-flight batch.
+
+The governing invariant: donation is an ALIASING contract, never a
+numerics change — donated and non-donated sweeps are BIT-IDENTICAL for
+the fedavg slot path, the seq family and the retrain-free reconstruction
+path, and a transient-failure retry after a donating dispatch recovers
+bit-identically (the dispatch closures re-materialize every device input
+from host arrays, so a dead donated buffer can never be re-submitted).
+The savings are plumbed into the coalition-cap autotune: with donation
+on, the modeled per-coalition state footprint halves and the computed
+cap ceiling rises."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mplc_tpu import faults
+from mplc_tpu.contrib.engine import CharacteristicEngine
+from mplc_tpu.contrib.shapley import powerset_order
+from mplc_tpu.obs import metrics, report, trace
+
+SUBSETS = powerset_order(4)
+
+_KNOBS = ("MPLC_TPU_DONATE_BUFFERS", "MPLC_TPU_PROGRAM_BANK",
+          "MPLC_TPU_FAULT_PLAN", "MPLC_TPU_PIPELINE_BATCHES",
+          "MPLC_TPU_SEED_ENSEMBLE", "MPLC_TPU_PARTNER_FAULT_PLAN",
+          "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_BATCH_CAP_CEILING")
+
+
+@pytest.fixture(autouse=True)
+def _env(monkeypatch):
+    for k in _KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def scenario(approach="fedavg", seed=9):
+    from helpers import build_scenario
+    return build_scenario(partners_count=4,
+                          amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
+                          dataset_name="titanic", epoch_count=2,
+                          gradient_updates_per_pass_count=2,
+                          multi_partner_learning_approach=approach,
+                          seed=seed)
+
+
+_REF = {}
+
+
+def reference(approach="fedavg", monkeypatch=None):
+    """Non-donated, bank-less v(S) table, computed once per approach per
+    pytest process (the autouse fixture guarantees a clean env)."""
+    if approach not in _REF:
+        monkeypatch.setenv("MPLC_TPU_DONATE_BUFFERS", "0")
+        monkeypatch.setenv("MPLC_TPU_PROGRAM_BANK", "0")
+        _REF[approach] = CharacteristicEngine(
+            scenario(approach)).evaluate(SUBSETS)
+        monkeypatch.delenv("MPLC_TPU_DONATE_BUFFERS")
+        monkeypatch.delenv("MPLC_TPU_PROGRAM_BANK")
+    return _REF[approach]
+
+
+# -- bit-identity ------------------------------------------------------------
+
+def test_donation_actually_consumes_the_state(monkeypatch):
+    """Ground truth that donation is ON and really aliasing: the input
+    state's buffers are deleted by a donating epoch chunk, and survive
+    with the knob off."""
+    monkeypatch.setenv("MPLC_TPU_PROGRAM_BANK", "0")
+    eng = CharacteristicEngine(scenario())
+    tr = eng.multi_pipe.trainer
+    mask = jnp.ones((eng.partners_count,), jnp.float32)
+
+    state = tr.init_state(jax.random.PRNGKey(0), eng.partners_count)
+    new = tr.jit_epoch_chunk(state, eng.stacked, eng.val, mask,
+                             jax.random.PRNGKey(1), n_epochs=1)
+    assert jax.tree_util.tree_leaves(state.params)[0].is_deleted()
+    assert not jax.tree_util.tree_leaves(new.params)[0].is_deleted()
+
+    monkeypatch.setenv("MPLC_TPU_DONATE_BUFFERS", "0")
+    state2 = tr.init_state(jax.random.PRNGKey(0), eng.partners_count)
+    tr.jit_epoch_chunk(state2, eng.stacked, eng.val, mask,
+                       jax.random.PRNGKey(1), n_epochs=1)
+    assert not jax.tree_util.tree_leaves(state2.params)[0].is_deleted()
+
+
+def test_donated_sweep_bit_identical_fedavg(monkeypatch):
+    ref = reference("fedavg", monkeypatch)
+    monkeypatch.setenv("MPLC_TPU_PROGRAM_BANK", "0")  # isolate donation
+    vals = CharacteristicEngine(scenario()).evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    # the table must discriminate, or the equality contract is vacuous
+    assert ref.max() - ref.min() > 1e-3
+
+
+def test_donated_sweep_bit_identical_seq(monkeypatch):
+    """The seq family routes through the slot engine's sequential
+    partner scan — a different carry structure through the donating
+    jits, equality-tested separately."""
+    ref = reference("seq-with-final-agg", monkeypatch)
+    monkeypatch.setenv("MPLC_TPU_PROGRAM_BANK", "0")
+    vals = CharacteristicEngine(
+        scenario("seq-with-final-agg")).evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+
+
+def test_donated_reconstruction_bit_identical(monkeypatch):
+    """The retrain-free path: the recording run's init params are copied
+    out BEFORE the donating chunk loop consumes the state, and the
+    reconstruction scan donates only its per-batch mask buffer — so
+    donated and non-donated reconstructed v(S) tables are bit-identical."""
+    from mplc_tpu.contrib.reconstruct import ReconstructionEvaluator
+
+    monkeypatch.setenv("MPLC_TPU_PROGRAM_BANK", "0")
+    monkeypatch.setenv("MPLC_TPU_DONATE_BUFFERS", "0")
+    ref = ReconstructionEvaluator(
+        CharacteristicEngine(scenario())).evaluate(SUBSETS)
+    monkeypatch.delenv("MPLC_TPU_DONATE_BUFFERS")
+    vals = ReconstructionEvaluator(
+        CharacteristicEngine(scenario())).evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+
+
+# -- the donation/retry rule -------------------------------------------------
+
+def test_transient_retry_after_donating_dispatch_bit_identical(monkeypatch):
+    """A donating dispatch that fails leaves its donated buffers DEAD;
+    the retry must re-materialize every input from host arrays and
+    recover bit-identically (extends the tests/test_faults.py pattern
+    with donation explicitly on)."""
+    ref = reference("fedavg", monkeypatch)
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "transient@batch2")
+    eng = CharacteristicEngine(scenario())
+    assert eng.multi_pipe._fin_donates  # donation really on
+    vals = eng.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    assert metrics.snapshot()["counters"]["engine.retries"] == 1
+
+
+def test_harvest_redispatch_after_donation_bit_identical(monkeypatch):
+    """Harvest-side transient: the re-dispatch rebuilds the SAME batch
+    from host arrays after the first (donating) dispatch's buffers are
+    gone."""
+    ref = reference("fedavg", monkeypatch)
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "transient@harvest2")
+    vals = CharacteristicEngine(scenario()).evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    assert metrics.snapshot()["counters"]["engine.retries"] == 1
+
+
+def test_oom_ladder_with_donation_bit_identical(monkeypatch):
+    """Donation composes with the OOM cap-halving ladder: re-bucketed
+    batches re-materialize and retrain bit-identically."""
+    ref = reference("fedavg", monkeypatch)
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "oom@batch2")
+    eng = CharacteristicEngine(scenario())
+    vals = eng.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    assert eng._cap_halvings == 1
+
+
+# -- cap autotune & the hbm row ----------------------------------------------
+
+def _stub_memory(eng, param_bytes=64 << 20, hbm=8 << 30):
+    """The memory-stats stub pattern from tests/test_dispatch_fusion.py:
+    pin the model size and device limit so the autotune is deterministic
+    and memory (not the ceiling) binds."""
+    eng._param_bytes = param_bytes
+    eng._hbm_bytes = hbm
+
+
+def test_donation_raises_autotuned_cap(monkeypatch):
+    """The HBM saving is plumbed into the cap autotune: with donation on
+    the modeled per-coalition state footprint halves, so the computed
+    coalitions-per-device ceiling RISES (here: exactly doubles, params
+    dominating the activation window)."""
+    monkeypatch.delenv("MPLC_TPU_COALITIONS_PER_DEVICE", raising=False)
+    monkeypatch.setenv("MPLC_TPU_BATCH_CAP_CEILING", "1024")
+    eng = CharacteristicEngine(scenario())
+    _stub_memory(eng)
+    cap_off = eng._autotuned_cap(None, False, False)
+    cap_on = eng._autotuned_cap(None, False, True)
+    assert cap_on > cap_off
+    # the state term dominates at 64MB params, so the cap ~doubles
+    # (floor rounding of the activation share can cost at most one slot)
+    assert cap_on >= 2 * cap_off - 1
+    # and the policy-following cap picks the donated number by default
+    assert eng._device_batch_cap() == cap_on
+    monkeypatch.setenv("MPLC_TPU_DONATE_BUFFERS", "0")
+    assert eng._device_batch_cap() == cap_off
+
+
+def test_hbm_row_reports_donation_saving_and_cap_uplift(monkeypatch):
+    """The sweep report's hbm row: per-coalition footprint, the donation
+    saving, cap before/after donation — and format_report renders it."""
+    monkeypatch.setenv("MPLC_TPU_PROGRAM_BANK", "0")
+    monkeypatch.delenv("MPLC_TPU_COALITIONS_PER_DEVICE", raising=False)
+    monkeypatch.setenv("MPLC_TPU_BATCH_CAP_CEILING", "1024")
+    eng = CharacteristicEngine(scenario())
+    _stub_memory(eng)
+    with trace.collect() as recs:
+        eng.evaluate([(0,), (0, 1)])
+    rep = report.sweep_report(recs)
+    h = rep["hbm"]
+    assert h["donation"] is True
+    assert h["donated_bytes_per_coalition"] > 0
+    assert h["cap_after_donation"] > h["cap_before_donation"]
+    text = report.format_report(rep)
+    assert "hbm" in text
+    assert (f"cap {h['cap_before_donation']}->{h['cap_after_donation']}"
+            in text)
+    # old reports without the row still format
+    old = dict(rep)
+    old.pop("hbm")
+    assert "hbm" not in report.format_report(old)
+
+
+def test_memory_stats_requeried_after_degrade(monkeypatch):
+    """ISSUE 8 satellite: the per-engine memory_stats snapshot must be
+    invalidated on every engine.degrade event — the autotuner otherwise
+    reasons from pre-fault memory after OOM cap-halving or CPU
+    degradation."""
+    monkeypatch.delenv("MPLC_TPU_COALITIONS_PER_DEVICE", raising=False)
+    eng = CharacteristicEngine(scenario())
+    calls = {"n": 0}
+
+    class Dev:
+        def memory_stats(self):
+            calls["n"] += 1
+            return {"bytes_limit": 8 << 30}
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [Dev()])
+    eng._device_batch_cap()
+    eng._device_batch_cap()
+    assert calls["n"] == 1  # memoized on the happy path (PR 2 behavior)
+    eng._degrade_cap(faults.InjectedOom("RESOURCE_EXHAUSTED: test"))
+    eng._device_batch_cap()
+    eng._device_batch_cap()
+    assert calls["n"] == 2  # re-queried exactly once after the degrade

@@ -1,4 +1,4 @@
-"""Knob-hygiene static check: every `MPLC_TPU_*` env knob the framework
+"""Knob-hygiene static checks: every `MPLC_TPU_*` env knob the framework
 reads must be registered in `constants.ENV_KNOBS`, and every registered
 knob's class obligations must hold in bench.py — workload-shaping knobs
 appear in BOTH the cached-replay refusal list and the CPU-fallback
@@ -6,8 +6,17 @@ env-strip list, sidecar knobs at least in the strip list.
 
 PRs 1-3 each extended bench's two lists by hand; this test makes
 forgetting one (or introducing an unregistered knob) a fast-tier failure
-instead of a silently wrong cached-replay / fallback number."""
+instead of a silently wrong cached-replay / fallback number.
 
+Donation-policy lint (ISSUE 8 satellite): every `jax.jit` call under
+`mplc_tpu/` must either declare `donate_argnums`/`donate_argnames`
+(including an explicit empty tuple — the conditional donation idiom) or
+appear in the no-donation allowlist below with a reason string. A jit
+that silently omits the decision is how param-side HBM regresses: the
+next state-carrying jit someone adds would hold two copies of its
+buffers without anyone choosing that."""
+
+import ast
 import importlib
 import inspect
 import re
@@ -108,6 +117,111 @@ def test_sidecar_knobs_are_stripped_from_fallback():
             assert knob in src_spawn, (
                 f"sidecar knob {knob} missing from "
                 "bench._spawn_cpu_fallback's env-strip list")
+
+
+# -- donation-policy lint ----------------------------------------------------
+#
+# (relpath, dotted enclosing scope) -> reason the jit deliberately does
+# NOT donate. Every entry must stay live (a stale entry fails below) and
+# carry a non-empty reason.
+_NO_DONATION_ALLOWLIST = {
+    ("mplc_tpu/mpl/engine.py", "MplTrainer.jit_finalize"):
+        "the fit driver (mpl/approaches.py) and the partner-shard tests "
+        "read state.params and the histories AFTER finalize",
+    ("mplc_tpu/mpl/engine.py", "MplTrainer.jit_evaluate"):
+        "callers (PVRL's reward eval) pass the LIVE carried params, which "
+        "train on in the next epoch",
+    ("mplc_tpu/mpl/engine.py", "MplTrainer.jit_batched_init"):
+        "the rng batch is the only array input and the caller passes it "
+        "again to the epoch chunk",
+    ("mplc_tpu/contrib/engine.py", "_fold_bitmask_keys"):
+        "inputs are tiny uint32 word arrays plus the engine's SHARED seed "
+        "key, which every later batch folds again",
+    ("mplc_tpu/contrib/engine.py", "_fold_bitmask_keys_seeded"):
+        "the ensemble seed-row table is reused by every batch of the sweep",
+    ("mplc_tpu/contrib/engine.py", "Batched2DTrainerPipeline.__init__"):
+        "init2d's rng batch is reused by the epoch chunk (the run/fin jits "
+        "built here DO declare donation)",
+    ("mplc_tpu/parallel/partner_shard.py",
+     "PartnerShardedTrainer.init_state"):
+        "the rng input is reused by the epoch chunk's training streams",
+    ("mplc_tpu/parallel/partner_shard.py", "PartnerShardedTrainer.finalize"):
+        "tests/test_partner_shard.py reads state.params and the val "
+        "histories AFTER finalize",
+}
+
+
+def _jit_calls(path: Path):
+    """(dotted scope, lineno, declares_donation) for every jax.jit call —
+    including bare `@jax.jit` decorators — in one source file."""
+    tree = ast.parse(path.read_text())
+    found = []
+    stack = []
+
+    def is_jax_jit(node):
+        return (isinstance(node, ast.Attribute) and node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax")
+
+    class Visitor(ast.NodeVisitor):
+        def _scoped(self, node):
+            stack.append(node.name)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if is_jax_jit(dec):  # bare @jax.jit: no kwargs possible
+                        found.append((".".join(stack), dec.lineno, False))
+            self.generic_visit(node)
+            stack.pop()
+
+        visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = _scoped
+
+        def visit_Call(self, node):
+            if is_jax_jit(node.func):
+                declares = any(
+                    kw.arg in ("donate_argnums", "donate_argnames")
+                    for kw in node.keywords)
+                found.append((".".join(stack), node.lineno, declares))
+            self.generic_visit(node)
+
+    Visitor().visit(tree)
+    return found
+
+
+def _all_jit_calls():
+    out = []
+    for f in sorted((REPO / "mplc_tpu").rglob("*.py")):
+        rel = f.relative_to(REPO).as_posix()
+        for scope, lineno, declares in _jit_calls(f):
+            out.append((rel, scope, lineno, declares))
+    return out
+
+
+def test_every_jit_declares_a_donation_policy():
+    """The HBM-regression guard: a `jax.jit` under mplc_tpu/ either
+    declares donate_argnums (possibly conditionally empty) or is
+    allowlisted with a reason for why its inputs must survive the call."""
+    undeclared = [
+        f"{rel}:{lineno} (in {scope or '<module>'})"
+        for rel, scope, lineno, declares in _all_jit_calls()
+        if not declares and (rel, scope) not in _NO_DONATION_ALLOWLIST]
+    assert not undeclared, (
+        "jax.jit calls without a donation policy: " + ", ".join(undeclared)
+        + " — declare donate_argnums (donating the dead state argument, "
+        "or an explicit () if nothing can be donated) or add the call's "
+        "(file, scope) to _NO_DONATION_ALLOWLIST with a reason")
+
+
+def test_donation_allowlist_is_not_stale_and_has_reasons():
+    live = {(rel, scope) for rel, scope, _, declares in _all_jit_calls()
+            if not declares}
+    stale = set(_NO_DONATION_ALLOWLIST) - live
+    assert not stale, (
+        f"_NO_DONATION_ALLOWLIST entries {sorted(stale)} no longer match "
+        "an undeclared jax.jit call — remove them (or the jit they "
+        "described gained donate_argnums, which supersedes the entry)")
+    for key, reason in _NO_DONATION_ALLOWLIST.items():
+        assert isinstance(reason, str) and reason.strip(), (
+            f"allowlist entry {key} needs a non-empty reason string")
 
 
 def test_synth_noise_refusal_is_non_default_only(tmp_path, monkeypatch):

@@ -1,0 +1,242 @@
+"""The AOT program bank (ISSUE 8): slot programs compiled ahead of first
+dispatch, compile/execute overlap on a background thread, process-global
+reuse, and the persistent manifest that turns the compile-cache dir into
+a queryable bank (bench warm-up skip).
+
+Invariants under test: banked and freshly-jit-compiled sweeps are
+BIT-IDENTICAL (including under injected transient/OOM faults); a repeat
+sweep of the same shape reports (near-)zero serial compile time; every
+bucket after the first compiles on the background worker (overlapped),
+so the serial compile row is the first bucket only."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mplc_tpu.contrib import bank as bank_mod
+from mplc_tpu.contrib.engine import CharacteristicEngine
+from mplc_tpu.contrib.shapley import powerset_order
+from mplc_tpu.obs import metrics, report, trace
+
+SUBSETS = powerset_order(4)
+
+_KNOBS = ("MPLC_TPU_DONATE_BUFFERS", "MPLC_TPU_PROGRAM_BANK",
+          "MPLC_TPU_FAULT_PLAN", "MPLC_TPU_PIPELINE_BATCHES",
+          "MPLC_TPU_SEED_ENSEMBLE", "MPLC_TPU_PARTNER_FAULT_PLAN",
+          "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_COMPILE_CACHE_DIR")
+
+
+@pytest.fixture(autouse=True)
+def _env(monkeypatch):
+    for k in _KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
+    metrics.reset()
+    bank_mod.reset_bank()
+    yield
+    metrics.reset()
+    bank_mod.reset_bank()
+
+
+def scenario(seed=9):
+    from helpers import build_scenario
+    return build_scenario(partners_count=4,
+                          amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
+                          dataset_name="titanic", epoch_count=2,
+                          gradient_updates_per_pass_count=2, seed=seed)
+
+
+_REF = {}
+
+
+def reference(monkeypatch):
+    """Bank-less v(S), computed once per process (donation left at its
+    default so this isolates the BANK, not donation)."""
+    if "vals" not in _REF:
+        monkeypatch.setenv("MPLC_TPU_PROGRAM_BANK", "0")
+        _REF["vals"] = CharacteristicEngine(scenario()).evaluate(SUBSETS)
+        monkeypatch.delenv("MPLC_TPU_PROGRAM_BANK")
+    return _REF["vals"]
+
+
+# -- bit-identity & the compile rows -----------------------------------------
+
+def test_banked_sweep_bit_identical_and_overlapped(monkeypatch):
+    """One cold banked sweep: bit-identical values, exactly one serial
+    (foreground) bank compile — the first bucket — and every later
+    bucket compiled on the background worker (overlapped), which the
+    report separates from the serial compile row."""
+    ref = reference(monkeypatch)
+    with trace.collect() as recs:
+        eng = CharacteristicEngine(scenario())
+        assert eng.program_bank is not None
+        vals = eng.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+
+    evts = [r["attrs"] for r in recs if r["name"] == "bank.compile"]
+    # 4-partner merge plan: singles (foreground) + slot-3 + slot-4 buckets
+    assert len(evts) == 3
+    assert [a["overlapped"] for a in evts].count(False) == 1
+    assert [a["overlapped"] for a in evts].count(True) == 2
+    # the jit path never compiled: the bank served every dispatch
+    assert not [r for r in recs if r["name"] == "trainer.compile"]
+
+    rep = report.sweep_report(recs)
+    pb = rep["program_bank"]
+    assert (pb["compiles"], pb["compiles_overlapped"]) == (3, 2)
+    assert pb["overlapped_s"] == rep["wallclock"]["compile_overlapped_s"]
+    # any stall behind the background worker is booked as SERIAL time
+    assert pb["waited_s"] <= rep["wallclock"]["compile_s"]
+    assert rep["wallclock"]["compile_s"] > 0          # first bucket only
+    assert rep["wallclock"]["compile_overlapped_s"] > 0
+    assert rep["compiles"]                             # per-program view
+    text = report.format_report(rep)
+    assert "bank" in text and "compile_overlapped=" in text
+
+
+def test_warm_bank_repeat_sweep_reports_zero_compile(monkeypatch):
+    """The acceptance criterion: a repeat sweep of the same shape with a
+    warm (process-global) bank compiles NOTHING — serial and overlapped
+    compile rows both ~zero, every program served from the bank."""
+    ref = reference(monkeypatch)
+    CharacteristicEngine(scenario()).evaluate(SUBSETS)  # primes the bank
+    with trace.collect() as recs:
+        vals = CharacteristicEngine(scenario()).evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    rep = report.sweep_report(recs)
+    assert rep["wallclock"]["compile_s"] == 0.0
+    assert rep["wallclock"]["compile_overlapped_s"] == 0.0
+    assert not [r for r in recs if r["name"] in ("bank.compile",
+                                                 "trainer.compile")]
+    assert metrics.snapshot()["counters"]["bank.hits"] >= 3
+
+
+def test_banked_sweep_bit_identical_under_faults(monkeypatch):
+    """Bank x PR-4 ladder: a transient retry re-dispatches through the
+    SAME banked executable; an OOM re-bucket drops to the inline jit
+    path at the degraded width — recovered values stay bit-identical."""
+    ref = reference(monkeypatch)
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN",
+                       "transient@batch2,oom@batch3")
+    eng = CharacteristicEngine(scenario())
+    vals = eng.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    assert eng._cap_halvings == 1
+    snap = metrics.snapshot()["counters"]
+    assert snap["engine.retries"] == 1
+    assert snap["engine.faults_injected"] == 2
+
+
+def test_bank_disabled_restores_inline_jit_path(monkeypatch):
+    """MPLC_TPU_PROGRAM_BANK=0: no bank is constructed, nothing AOT-
+    compiles, and the sweep still produces the reference table through
+    the inline jit path. (trainer.compile events are NOT asserted here:
+    the shared trainer registry may already hold this config's compiled
+    jits from earlier tests in the process.)"""
+    ref = reference(monkeypatch)
+    monkeypatch.setenv("MPLC_TPU_PROGRAM_BANK", "0")
+    with trace.collect() as recs:
+        eng = CharacteristicEngine(scenario())
+        assert eng.program_bank is None
+        vals = eng.evaluate(SUBSETS)
+    np.testing.assert_array_equal(vals, ref)
+    assert not [r for r in recs if r["name"] == "bank.compile"]
+
+
+def test_sweep_plan_matches_executed_buckets_under_partner_faults(
+        monkeypatch):
+    """sweep_plan must mirror evaluate()'s routing EXACTLY — including
+    under a partner fault plan, where coalitions classify by EFFECTIVE
+    size but bucket widths come from the ORIGINAL membership and
+    all-dropped coalitions never dispatch. A divergence here makes the
+    bench warm-up prove (or pre-load) the wrong program set."""
+    monkeypatch.setenv("MPLC_TPU_PARTNER_FAULT_PLAN", "dropout@p1:epoch1")
+    eng = CharacteristicEngine(scenario())
+    plan = eng.sweep_plan(SUBSETS)
+    with trace.collect() as recs:
+        eng.evaluate(SUBSETS)
+    executed = {(r["attrs"]["slot_count"], r["attrs"]["width"])
+                for r in recs if r["name"] == "engine.batch"}
+    assert {(sc_, w) for _, sc_, w in plan} == executed
+    # and fault-free plans match too (the base contract)
+    monkeypatch.delenv("MPLC_TPU_PARTNER_FAULT_PLAN")
+    eng2 = CharacteristicEngine(scenario(seed=17))
+    plan2 = eng2.sweep_plan(SUBSETS)
+    with trace.collect() as recs2:
+        eng2.evaluate(SUBSETS)
+    executed2 = {(r["attrs"]["slot_count"], r["attrs"]["width"])
+                 for r in recs2 if r["name"] == "engine.batch"}
+    assert {(sc_, w) for _, sc_, w in plan2} == executed2
+
+
+# -- persistence: the manifest -----------------------------------------------
+
+def test_manifest_persists_program_keys(tmp_path, monkeypatch):
+    """With a compile-cache dir configured, every bank compile records
+    its program key in the manifest — and a FRESH process (simulated by
+    clearing the in-memory store) can prove it holds a sweep's whole
+    program set without compiling anything."""
+    monkeypatch.setenv("MPLC_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+    eng = CharacteristicEngine(scenario())
+    eng.evaluate(SUBSETS)
+    manifest = tmp_path / bank_mod.MANIFEST_NAME
+    assert manifest.exists()
+    keys = set(json.loads(manifest.read_text())["programs"])
+    assert len(keys) == 3  # singles + slot-3 + slot-4 programs
+
+    bank_mod.reset_bank()  # simulate a process restart
+    eng2 = CharacteristicEngine(scenario())
+    plan = eng2.sweep_plan(SUBSETS)
+    assert len(plan) == 3
+    assert eng2.program_bank.holds_persistent(plan)
+    # a different shape (different width plan) is NOT claimed
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "4")
+    wider = eng2.sweep_plan(SUBSETS)
+    assert any(w != pw for (_, _, w), (_, _, pw) in zip(wider, plan))
+    assert not eng2.program_bank.holds_persistent(wider)
+
+
+def test_no_manifest_dir_means_no_persistence(monkeypatch):
+    """Without a cache dir there is nothing to prove warm starts from:
+    holds_persistent is False and nothing is written anywhere."""
+    monkeypatch.setattr(bank_mod, "manifest_dir", lambda: None)
+    eng = CharacteristicEngine(scenario(seed=31))
+    plan = eng.sweep_plan([(0,), (0, 1)])
+    assert eng.program_bank.persistent_keys() == set()
+    assert not eng.program_bank.holds_persistent(plan)
+
+
+# -- bench warm-up skip ------------------------------------------------------
+
+def test_bench_warmup_skips_compile_prime_on_warm_bank(tmp_path,
+                                                       monkeypatch):
+    """bench._warm_engine: the first run compiles (and records the
+    manifest); a second run of the SAME sweep shape proves the bank
+    holds every program and skips the compile-prime loop entirely,
+    recording `warmup_skipped` provenance for the sidecar."""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    import bench
+
+    monkeypatch.setenv("MPLC_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+    warm1 = bench._warm_engine(scenario())
+    assert bench._COMPILE_CACHE["warmup_skipped"] is False
+    assert warm1.first_charac_fct_calls_count > 0  # the prime really ran
+
+    bank_mod.reset_bank()  # fresh process: only the manifest survives
+    warm2 = bench._warm_engine(scenario())
+    assert bench._COMPILE_CACHE["warmup_skipped"] is True
+    assert warm2.first_charac_fct_calls_count == 0  # nothing evaluated
+
+    # and the sidecar's compile_cache block carries the provenance
+    sidecar = tmp_path / "telemetry.json"
+    monkeypatch.setenv("BENCH_TELEMETRY_FILE", str(sidecar))
+    bench._COMPILE_CACHE.update(dir=str(tmp_path), entries_at_start=1)
+    bench._write_telemetry({"metric": "unit", "wallclock_s": 1.0},
+                           repo_root=str(tmp_path))
+    rec = json.loads(sidecar.read_text())
+    assert rec["compile_cache"]["warmup_skipped"] is True
