@@ -10,6 +10,12 @@ Configs (select with BENCH_CONFIG, default "1"):
      AIS_Kriging_S), MNIST, 10 partners
   4  stratified MC Shapley (BENCH_METHOD: SMCS / WR_SMC), IMDB, 4 partners
   5  TMCS + Independent scores, CIFAR10, 8 partners with 2 corrupted
+  6  multi-tenant sweep service (mplc_tpu/service/): BENCH_TENANTS exact
+     Shapley games (default 2, distinct seeds) submitted to one
+     SweepService — measures scheduler overhead, cross-tenant program
+     packing (the sidecar's service row carries packed-batch counts and
+     per-tenant fair-share cost attribution) and journaling cost
+     (MPLC_TPU_SERVICE_SLICE / _MAX_PENDING / _FAULT_PLAN apply)
 
 Workload notes. The reference (saved_experiments results.csv) trains ONE
 fedavg MNIST model in ~589 s wall-clock at 50 epochs and needs one full
@@ -208,6 +214,10 @@ _WORKLOAD_KNOBS = (
     "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_FAULT_PLAN",
     "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
     "MPLC_TPU_RETRY_BACKOFF_SEC", "MPLC_TPU_SEED_ENSEMBLE",
+    # the service knobs reshape the multi-tenant workload (injected
+    # faults, slice granularity, admission bounds)
+    "MPLC_TPU_SERVICE_FAULT_PLAN", "MPLC_TPU_SERVICE_MAX_PENDING",
+    "MPLC_TPU_SERVICE_SLICE",
     "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
     "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SVARM_SAMPLES",
     "MPLC_TPU_SYNTH_SCALE")
@@ -371,7 +381,8 @@ def _amounts(n_partners):
     return [x / sum(a) for x in a]
 
 
-def _make_scenario(dataset_name, n_partners, epochs, dtype, corrupted=None):
+def _make_scenario(dataset_name, n_partners, epochs, dtype, corrupted=None,
+                   seed=0):
     from mplc_tpu.scenario import Scenario
 
     sc = Scenario(partners_count=n_partners,
@@ -382,7 +393,8 @@ def _make_scenario(dataset_name, n_partners, epochs, dtype, corrupted=None):
                   minibatch_count=10, gradient_updates_per_pass_count=8,
                   is_early_stopping=False, compute_dtype=dtype,
                   corrupted_datasets=corrupted,
-                  experiment_path="/tmp/mplc_bench", is_dry_run=True, seed=0)
+                  experiment_path="/tmp/mplc_bench", is_dry_run=True,
+                  seed=seed)
     sc.instantiate_scenario_partners()
     sc.split_data(is_logging_enabled=False)
     sc.compute_batch_sizes()
@@ -408,7 +420,7 @@ def _attach_progress(engine, label):
     return engine
 
 
-def _warm_engine(sc):
+def _warm_engine(sc, shared_bank=False):
     """Compile every program the timed run will execute. The engine pads
     each evaluate() call to one bucket width per slot bucket
     (contrib/engine.py _run_batch / _slot_buckets), so warming with
@@ -416,13 +428,22 @@ def _warm_engine(sc):
     grouped by engine._slot_width, overlap-halved cap mirrored — hits
     exactly the (width, slot-size) programs a full sweep uses. Adaptive MC
     methods can still trigger one smaller width on a late, short batch —
-    that residual compile is accepted and visible, not hidden."""
+    that residual compile is accepted and visible, not hidden.
+
+    `shared_bank` (the service bench): re-key the warm engine's program
+    bank in SHARED (shape) scope before anything compiles, so one warm-up
+    pass banks directly under the keys the SweepService's tenant engines
+    acquire with — per-game keys would prime a bank the service never
+    reads, paying every AOT compile twice."""
     from itertools import combinations, islice
     from math import comb
 
     from mplc_tpu.contrib.engine import CharacteristicEngine
 
     warm = _attach_progress(CharacteristicEngine(sc), "warm")
+    if shared_bank and warm.program_bank is not None:
+        from mplc_tpu.contrib.bank import ProgramBank
+        warm.program_bank = ProgramBank(warm, shared=True)
     n = warm.partners_count
     # Program-bank warm-start: when the persistent bank manifest proves a
     # previous run already compiled EVERY (slots, width) program a full
@@ -738,6 +759,66 @@ def bench_exact_shapley(epochs, dtype):
     _emit(metric, elapsed, _baseline_seconds(dataset, epochs, B))
 
 
+def bench_service(epochs, dtype):
+    """Config 6: the multi-tenant sweep service. BENCH_TENANTS exact
+    Shapley games of the same shape (distinct seeds) run through ONE
+    SweepService with a journal, so the timed number covers scheduler
+    overhead, per-value WAL fsyncs, and the cross-tenant program-packing
+    win (the second tenant's buckets should be program-bank hits — the
+    sidecar's service row says whether they were)."""
+    from mplc_tpu.contrib.shapley import powerset_order
+    from mplc_tpu.obs import trace as obs_trace
+    from mplc_tpu.obs.report import format_report, sweep_report
+    from mplc_tpu.service import SweepService
+
+    dataset = os.environ.get("BENCH_DATASET", "mnist")
+    n_partners = int(os.environ.get("BENCH_PARTNERS", "5"))
+    tenants = int(os.environ.get("BENCH_TENANTS", "2"))
+    B = len(powerset_order(n_partners))
+
+    scenarios = [_make_scenario(dataset, n_partners, epochs, dtype,
+                                seed=seed) for seed in range(tenants)]
+    # prime the compiles OUTSIDE the timed region (same discipline as the
+    # single-tenant configs): tenant 0's warm-up banks every program the
+    # shape needs, and the service's shared-scope bank serves the rest
+    # the warm engine banks under the SAME shared-scope keys the
+    # service's tenant engines acquire with — one compile pass serves
+    # every tenant of the shape
+    warm = _warm_engine(scenarios[0], shared_bank=True)
+    print("[bench] compiled; timing the service...", file=sys.stderr)
+
+    journal = os.path.join("/tmp/mplc_bench", f"service_wal_{os.getpid()}.jsonl")
+    t0 = time.perf_counter()
+    with obs_trace.collect() as tele:
+        svc = SweepService(journal_path=journal)
+        jobs = [svc.submit(sc, tenant=f"tenant{i}")
+                for i, sc in enumerate(scenarios)]
+        for job in jobs:
+            # consuming the stream doubles as watchdog liveness: every
+            # harvested value is a beat
+            for _ in job.stream(timeout=24 * 3600):
+                _beat()
+            job.result(timeout=60)
+        svc.shutdown(drain=True)
+    elapsed = time.perf_counter() - t0
+    del warm
+
+    rep = sweep_report(tele)
+    svc_row = rep.get("service", {})
+    print(f"[bench] service: {tenants} tenants x {B} coalitions in "
+          f"{elapsed:.1f} s; packed_batches="
+          f"{svc_row.get('cross_tenant_packed_batches')} "
+          f"completed={svc_row.get('completed')}", file=sys.stderr)
+    print(format_report(rep), file=sys.stderr, flush=True)
+    metric = (f"service_{tenants}tenants_{dataset}_{n_partners}partners_"
+              f"{epochs}epochs_wallclock")
+    _write_telemetry({"metric": metric, "wallclock_s": elapsed,
+                      "devices": _ndev(), "degraded": _degraded_run(rep),
+                      "report": rep})
+    _emit(metric, elapsed,
+          _baseline_seconds(dataset, epochs, tenants * B))
+
+
 def _bench_method(dataset_name, n_partners, method, epochs, dtype,
                   corrupted=None, extra_methods=()):
     """Shared driver for the MC/IS/stratified configs: run
@@ -859,8 +940,10 @@ def main():
         _bench_method("cifar10", 8, os.environ.get("BENCH_METHOD", "TMCS"),
                       epochs, dtype, corrupted=corrupted,
                       extra_methods=("Independent scores",))
+    elif config == "6":
+        bench_service(epochs, dtype)
     else:
-        raise SystemExit(f"unknown BENCH_CONFIG={config!r} (use 1-5)")
+        raise SystemExit(f"unknown BENCH_CONFIG={config!r} (use 1-6)")
 
     if _watchdog_fired.is_set():
         # The watchdog declared this run dead and its fallback child owns

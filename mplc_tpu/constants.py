@@ -229,6 +229,26 @@ COMPILE_CACHE_DIR_ENV = "MPLC_TPU_COMPILE_CACHE_DIR"
 GTG_TRUNCATION_ENV = "MPLC_TPU_GTG_TRUNCATION"
 SVARM_SAMPLES_ENV = "MPLC_TPU_SVARM_SAMPLES"
 
+# Sweep service (mplc_tpu/service/): the long-lived multi-tenant
+# scheduler — bounded submission queue, round-robin slicing across
+# tenants, per-tenant fault isolation, journaled crash recovery. All
+# read at SERVICE-CONSTRUCTION time with the warn+fallback parsers:
+#   MPLC_TPU_SERVICE_MAX_PENDING   admission-control bound on jobs not
+#                                  yet terminal (32); past it submit()
+#                                  raises ServiceOverloaded
+#   MPLC_TPU_SERVICE_SLICE         coalitions per scheduling quantum for
+#                                  exact sweeps (16): smaller = fairer
+#                                  interleaving + tighter deadline
+#                                  granularity, larger = fuller buckets
+#   MPLC_TPU_SERVICE_FAULT_PLAN    deterministic service-level fault
+#                                  plan, addressed by job submission
+#                                  ordinal (grammar in faults.py):
+#                                  crash@job2:batch3,reject@job4,
+#                                  stall@job1:sec2
+SERVICE_MAX_PENDING_ENV = "MPLC_TPU_SERVICE_MAX_PENDING"
+SERVICE_SLICE_ENV = "MPLC_TPU_SERVICE_SLICE"
+SERVICE_FAULT_PLAN_ENV = "MPLC_TPU_SERVICE_FAULT_PLAN"
+
 # ---------------------------------------------------------------------------
 # Env-knob registry. EVERY `MPLC_TPU_*` env var the framework reads must be
 # registered here with its class — tests/test_knob_hygiene.py greps the
@@ -270,6 +290,13 @@ ENV_KNOBS = {
     "MPLC_TPU_PARTNER_FAULT_PLAN": "workload",
     "MPLC_TPU_PARTNER_SHARDS": "workload",
     "MPLC_TPU_SEED_ENSEMBLE": "workload",
+    # the service knobs shape the multi-tenant bench workload: the fault
+    # plan injects faults, the slice reshapes bucket packing and the
+    # pending bound reshapes admission — none may leak into a cached
+    # replay or the CPU-fallback child
+    "MPLC_TPU_SERVICE_FAULT_PLAN": "workload",
+    "MPLC_TPU_SERVICE_MAX_PENDING": "workload",
+    "MPLC_TPU_SERVICE_SLICE": "workload",
     "MPLC_TPU_PIPELINE_BATCHES": "workload",
     "MPLC_TPU_RETRY_BACKOFF_SEC": "workload",
     "MPLC_TPU_SLOT_MERGE": "workload",

@@ -105,18 +105,48 @@ def manifest_dir() -> "str | None":
 
 
 class ProgramBank:
-    """Per-engine view onto the process-global AOT program store."""
+    """Per-engine view onto the process-global AOT program store.
 
-    def __init__(self, engine):
+    `shared=True` (the sweep service's mode) keys programs by SHAPE
+    identity instead of game identity: an XLA executable is a function of
+    argument shapes/dtypes and the compiled TrainConfig, never of data
+    VALUES, seeds or the data digest — so two tenants whose games share a
+    model, partner count and data shapes are served the SAME banked
+    executables (cross-tenant batch packing: the second tenant compiles
+    nothing the first already banked). Results stay bit-identical to a
+    private-bank run because every value-level input (data, rng keys,
+    masks) is a runtime argument. The default (per-game) scope is kept
+    for solo engines: it can never over-share, and its keys subsume the
+    shape key."""
+
+    def __init__(self, engine, shared: bool = False):
         self.engine = engine
+        self.shared = shared
         self._digest_cache = None
 
     # -- program identity ------------------------------------------------
 
+    def _shape_signature(self) -> list:
+        """Everything the compiled executables depend on OUTSIDE the
+        per-program key fields (repr(cfg), partners_count, slot/width,
+        donation, topology): the model identity and the shapes/dtypes of
+        the data arguments the programs are lowered against."""
+        eng = self.engine
+
+        def sig(tree):
+            return [[list(l.shape), str(l.dtype)]
+                    for l in jax.tree_util.tree_leaves(tree)]
+
+        return [eng.model.name, sig(eng.stacked), sig(eng.val),
+                sig(eng.test)]
+
     def _engine_digest(self) -> str:
         if self._digest_cache is None:
-            fp = json.dumps(self.engine._fingerprint(), sort_keys=True,
-                            default=str)
+            if self.shared:
+                fp = json.dumps(self._shape_signature(), default=str)
+            else:
+                fp = json.dumps(self.engine._fingerprint(), sort_keys=True,
+                                default=str)
             self._digest_cache = hashlib.sha256(fp.encode()).hexdigest()[:16]
         return self._digest_cache
 

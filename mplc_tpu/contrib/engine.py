@@ -86,6 +86,10 @@ def _memo_counters(hits: int, misses: int) -> "str | None":
     return method
 
 
+# one-time (per process) deprecation warning for legacy no-checksum caches
+_legacy_cache_warned = False
+
+
 class CacheIntegrityError(ValueError):
     """A coalition cache file is unreadable AS A FILE — truncated write,
     corrupted bytes, checksum mismatch, missing payload keys. Distinct
@@ -309,6 +313,10 @@ class CharacteristicEngine:
     _partner_faults: dict = {}
     _forever_dropped: frozenset = frozenset()
     program_bank = None
+    # set when a legacy (pre-checksum) cache was loaded: the next
+    # save_cache to that file rewrites it in the integrity format
+    _cache_needs_upgrade = False
+    _legacy_cache_path: "str | None" = None
 
     def __init__(self, scenario, share_data_from: "CharacteristicEngine | None" = None,
                  seed_ensemble: int | None = None):
@@ -971,6 +979,29 @@ class CharacteristicEngine:
                 "%d of %d) and re-bucketing the remaining subsets (%s)",
                 self._cap_halvings, self._max_cap_halvings, err)
 
+    def _ladder_exhausted(self, err: BaseException) -> "faults.LadderExhaustedError":
+        """Build (and record) the classified terminal error for a 2-D
+        sweep whose cap halvings ran out: the partner-sharded shard_map
+        programs need the device mesh, so there is no CPU rung to take.
+        The event lands in the resilience report row (ladder_exhausted),
+        and the error is classified PERMANENT (`faults.is_transient` /
+        `is_oom` both False) so the retry ladder can't loop on it and the
+        sweep service quarantines only the owning tenant's job. Raise the
+        returned error `from err` at the call site."""
+        obs_metrics.counter("engine.ladder_exhausted").inc()
+        obs_trace.event("engine.degrade", action="ladder_exhausted",
+                        halvings=self._cap_halvings, error=str(err)[:200])
+        return faults.LadderExhaustedError(
+            f"device OOM persisted through {self._max_cap_halvings} "
+            "cap-halvings and the 2-D partner-sharded mode has no CPU "
+            "rung (shard_map programs need the device mesh) — the sweep "
+            "cannot make progress at any cap. Remedies: lower "
+            "MPLC_TPU_COALITIONS_PER_DEVICE or MPLC_TPU_PARTNER_SHARDS, "
+            "shrink MPLC_TPU_EVAL_CHUNK, or "
+            "run this scenario on the 1-D path (which degrades to CPU). "
+            f"Last device error: {str(err)[:200]}",
+            halvings=self._cap_halvings, mode="2d")
+
     def _record_or_recover(self, prev, per_partner, slot_count, pipe) -> None:
         """`_record_group` plus the harvest-side OOM ladder: when FETCHING
         a batch's results exhausts device memory, the batch's coalitions
@@ -984,7 +1015,8 @@ class CharacteristicEngine:
                 raise
             self._degrade_cap(e)
             if self._cpu_degraded and getattr(pipe, "coal_devices", None):
-                raise  # no CPU path for the partner-sharded 2-D programs
+                # no CPU path for the partner-sharded 2-D programs
+                raise self._ladder_exhausted(e) from e
             if prev[3].get("ensemble"):
                 # job-granular group: redo every subset with ANY replica
                 # still missing (the re-run re-trains all K replicas —
@@ -1172,8 +1204,9 @@ class CharacteristicEngine:
                                                 slot_count, pipe)
                     self._degrade_cap(e)
                     if self._cpu_degraded and is2d:
-                        raise  # 2-D takes the halving rungs but has no CPU
-                               # rung: shard_map programs need the mesh
+                        # 2-D takes the halving rungs but has no CPU rung:
+                        # shard_map programs need the mesh
+                        raise self._ladder_exhausted(e) from e
                     continue
                 i += len(group)
                 if overlap:
@@ -1382,7 +1415,8 @@ class CharacteristicEngine:
             through a fresh call at the degraded cap."""
             self._degrade_cap(err)
             if self._cpu_degraded:
-                raise err  # 2-D singles ride the halving rungs only
+                # 2-D singles ride the halving rungs only
+                raise self._ladder_exhausted(err) from err
             redo = [s for s in singles if s not in self.charac_fct_values]
             if redo:
                 self._run_singles_sliced(redo)
@@ -1564,6 +1598,13 @@ class CharacteristicEngine:
                              and self._pipe2d is None else None)
                 obs_metrics.sample_device_memory()
                 obs_trace.event("engine.hbm", **self._hbm_attrs(slot_hint))
+        if self._cache_needs_upgrade and self.autosave_path is not None:
+            # legacy-cache convergence: even a fully-memoized sweep (no
+            # batch ran, so no per-batch autosave fired) rewrites the
+            # loaded no-checksum file ITSELF in the integrity format —
+            # autosaves pointed at a different path don't discharge the
+            # obligation to the legacy file
+            self.save_cache(self._legacy_cache_path)
         return np.array([self.charac_fct_values[k] for k in keys])
 
     def _slot_width(self, k: int) -> int:
@@ -1735,6 +1776,13 @@ class CharacteristicEngine:
                 _os.close(dfd)
         except OSError:
             pass  # platforms/filesystems without directory fsync
+        # every save emits the checksummed format — but the upgrade
+        # obligation is to the FILE the legacy cache was loaded from, so
+        # the flag clears only when that path was the one rewritten (an
+        # autosave pointed elsewhere must not strand the legacy file
+        # checksum-less while claiming it converged)
+        if str(path) == getattr(self, "_legacy_cache_path", str(path)):
+            self._cache_needs_upgrade = False
 
     def load_cache(self, path) -> None:
         """Restore a saved cache.
@@ -1766,6 +1814,24 @@ class CharacteristicEngine:
                     f"coalition cache {path} failed its checksum (stored "
                     f"{expected[:12]}…, recomputed {actual[:12]}…): the "
                     "file was corrupted after it was written")
+        else:
+            # legacy pre-checksum cache: loads unverified — corruption in
+            # it is UNDETECTABLE, which is exactly what the integrity
+            # format exists to rule out. Warn once per process, and flag
+            # the engine so the next autosave rewrites the file in the
+            # checksummed format: every on-disk cache converges to the
+            # integrity discipline without an explicit migration step.
+            import warnings
+            global _legacy_cache_warned
+            if not _legacy_cache_warned:
+                _legacy_cache_warned = True
+                warnings.warn(
+                    f"coalition cache {path} predates the checksum format "
+                    "and loads UNVERIFIED (corruption in it cannot be "
+                    "detected); it will be rewritten with a checksum on "
+                    "the next autosave", DeprecationWarning, stacklevel=2)
+            self._cache_needs_upgrade = True
+            self._legacy_cache_path = str(path)
         missing = {"fingerprint", "first_charac_fct_calls_count",
                    "charac_fct_values", "increments_values"} - payload.keys()
         if missing:

@@ -53,6 +53,12 @@ the partner's seeded generator. A repeated (kind, partner) pair warns and
 keeps the first entry; malformed entries warn and are skipped — same
 contract as the batch-fault plan.
 
+Service-level fault plan (`MPLC_TPU_SERVICE_FAULT_PLAN`): targets the
+multi-tenant sweep service (mplc_tpu/service/) by 1-based job submission
+ordinal — `crash@job2:batch3,reject@job4,stall@job1:sec2` — so isolation
+tests can fault exactly one tenant's job and assert the others
+unperturbed. Grammar and semantics with `parse_service_fault_plan` below.
+
 Injected exception classes mirror the real failures' types so the
 engine's classifier code paths are the ones exercised:
 
@@ -76,6 +82,7 @@ import warnings
 
 FAULT_PLAN_ENV = "MPLC_TPU_FAULT_PLAN"
 PARTNER_FAULT_PLAN_ENV = "MPLC_TPU_PARTNER_FAULT_PLAN"
+SERVICE_FAULT_PLAN_ENV = "MPLC_TPU_SERVICE_FAULT_PLAN"
 
 try:  # the concrete class jax raises for device/runtime failures
     from jaxlib.xla_extension import XlaRuntimeError as _XlaRuntimeError
@@ -97,6 +104,23 @@ class InjectedCrash(BaseException):
     absence of in-process recovery."""
 
 
+class LadderExhaustedError(RuntimeError):
+    """The OOM degrade ladder ran out of rungs with work still missing —
+    the classified form of what used to escape the 2-D mode as a raw
+    `XlaRuntimeError` (the 1-D paths have a terminal CPU rung; the
+    partner-sharded 2-D programs need the device mesh and cannot take
+    it). Carries the rung count and the mode so callers — the sweep
+    service above all — can act on it: it is PERMANENT (re-dispatching at
+    the same exhausted cap would OOM identically), so the service
+    quarantines only the owning tenant's job instead of retrying
+    forever, and the resilience report row records the exhaustion."""
+
+    def __init__(self, msg: str, *, halvings: int = 0, mode: str = "2d"):
+        super().__init__(msg)
+        self.halvings = halvings
+        self.mode = mode
+
+
 # Real XlaRuntimeError messages lead with a gRPC-style status code. Codes
 # that indicate a broken *program or request* are permanent: retrying the
 # identical dispatch can only fail identically. Everything else (INTERNAL,
@@ -104,6 +128,13 @@ class InjectedCrash(BaseException):
 # transient — the tunnel/fleet class of failure retries are for.
 _PERMANENT_STATUS = ("INVALID_ARGUMENT", "NOT_FOUND", "FAILED_PRECONDITION",
                      "UNIMPLEMENTED", "PERMISSION_DENIED", "UNAUTHENTICATED")
+# Statuses that are transient REGARDLESS of the exception class: the
+# service layer (queue timeouts, tunnel RPCs, control-plane calls) raises
+# them as plain RuntimeError/OSError on toolchains without the real
+# XlaRuntimeError symbol, and a DEADLINE_EXCEEDED that only rides the
+# retry ladder when jaxlib exports a class is a classifier bug — PR 4
+# only covered the statuses its injected faults carried.
+_TRANSIENT_STATUS = ("DEADLINE_EXCEEDED", "UNAVAILABLE")
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
                 "OOM when allocating")
 
@@ -113,6 +144,9 @@ def is_oom(err: BaseException) -> bool:
     never blind-retried (the identical batch would exhaust identically)."""
     if isinstance(err, InjectedOom):
         return True
+    if isinstance(err, LadderExhaustedError):
+        # the ladder's own terminal error must never re-enter the ladder
+        return False
     if not isinstance(err, Exception):
         return False
     msg = str(err)
@@ -121,21 +155,40 @@ def is_oom(err: BaseException) -> bool:
 
 def is_transient(err: BaseException) -> bool:
     """True for failures worth retrying bit-identically: injected
-    transients and real `XlaRuntimeError`s whose status code is not in the
-    permanent family. OOM is classified separately (`is_oom`); plain
-    Python exceptions (bugs) are never transient."""
+    transients, real `XlaRuntimeError`s whose status code is not in the
+    permanent family, and ANY exception whose message leads with an
+    always-transient gRPC status (DEADLINE_EXCEEDED / UNAVAILABLE — the
+    service-layer timeout family, which surfaces as plain exceptions on
+    toolchains without the XlaRuntimeError symbol). OOM is classified
+    separately (`is_oom`); other plain Python exceptions (bugs) are never
+    transient."""
     if isinstance(err, InjectedTransient):
         return True
     if is_oom(err):
         return False
+    if isinstance(err, LadderExhaustedError):
+        return False
+    msg = str(err).lstrip()
+
+    def leads_with(code: str) -> bool:
+        # the STATUS TOKEN must lead the message: a real gRPC status is
+        # followed by ':' or whitespace (or is the whole message), so
+        # "UNAVAILABLE_RESOURCE: config bug" must not ride the ladder
+        if not msg.startswith(code):
+            return False
+        rest = msg[len(code):]
+        return not rest or not (rest[0].isalnum() or rest[0] == "_")
+
+    if isinstance(err, Exception) and \
+            any(leads_with(code) for code in _TRANSIENT_STATUS):
+        return True
     if _XlaRuntimeError is RuntimeError:
         # toolchain without the real class: every RuntimeError would
         # match — refuse to blind-retry host-side bugs there
         return False
     if not isinstance(err, _XlaRuntimeError):
         return False
-    msg = str(err)
-    return not any(msg.lstrip().startswith(code) for code in _PERMANENT_STATUS)
+    return not any(msg.startswith(code) for code in _PERMANENT_STATUS)
 
 
 _ENTRY_RE = re.compile(
@@ -325,6 +378,95 @@ def forever_dropped(plan: dict) -> frozenset:
     partner-excluded fault-free runs)."""
     return frozenset(p for p, entry in plan.items()
                      if entry.get("dropout") == 1)
+
+
+# ---------------------------------------------------------------------------
+# Service-level fault plan (MPLC_TPU_SERVICE_FAULT_PLAN)
+# ---------------------------------------------------------------------------
+#
+# Where MPLC_TPU_FAULT_PLAN injects batch-boundary faults into ONE engine,
+# this plan targets the multi-tenant sweep service (mplc_tpu/service/):
+# entries address jobs by their 1-based SUBMISSION ordinal, so a two-tenant
+# isolation test can fault exactly tenant A's job and assert tenant B's
+# results bit-identical to a solo run. Comma-separated entries:
+#
+#   crash@job2:batch3      an InjectedCrash at the dispatch boundary of
+#                          job 2's 3rd device batch (batch ordinals are
+#                          per-JOB: each tenant engine counts its own)
+#   oom@job2:batch3        ditto, InjectedOom (drives that job's private
+#                          degrade ladder; other tenants' caps untouched)
+#   transient@job2:batch3  ditto, InjectedTransient (rides the retry rung)
+#   reject@job4            admission control refuses the 4th submission
+#                          (clean ServiceRejected, counted as rejected)
+#   stall@job1:sec2        the scheduler sleeps 2 s before job 1's next
+#                          quantum (a simulated hang; consumed once, and
+#                          the stall bills against THAT job's deadline)
+#
+# Batch-kind entries are installed into the target job's private engine
+# injector at job start, so the firing semantics (once per entry, retries
+# keep their ordinal) are exactly `FaultInjector`'s. Malformed entries
+# warn and are skipped — same contract as the other plans.
+
+_SERVICE_ENTRY_RE = re.compile(
+    r"^(crash|oom|transient)@job([0-9]+):batch([0-9]+)$"
+    r"|^(reject)@job([0-9]+)$"
+    r"|^(stall)@job([0-9]+):sec([0-9]+(?:\.[0-9]+)?)$")
+
+
+def parse_service_fault_plan(spec: str | None) -> dict:
+    """`{job_ordinal: {"batch": {(site, ordinal): [kind, ...]},
+    "reject": bool, "stall_sec": float}}` from the service-plan grammar.
+    Job ordinals are 1-based submission order. Malformed entries warn and
+    are dropped; empty/unset spec is the empty plan."""
+    plan: dict = {}
+    if not spec:
+        return plan
+
+    def slot(job: int) -> dict:
+        return plan.setdefault(job, {"batch": {}, "reject": False,
+                                     "stall_sec": 0.0})
+
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        m = _SERVICE_ENTRY_RE.match(entry)
+        if m is None:
+            warnings.warn(
+                f"{SERVICE_FAULT_PLAN_ENV}: ignoring malformed entry "
+                f"{entry!r} (expected <crash|oom|transient>@job<J>:batch<B> "
+                "| reject@job<J> | stall@job<J>:sec<F>)", stacklevel=2)
+            continue
+        if m.group(1):  # batch-boundary kind
+            job, ordinal = int(m.group(2)), int(m.group(3))
+            if job < 1 or ordinal < 1:
+                warnings.warn(
+                    f"{SERVICE_FAULT_PLAN_ENV}: ignoring entry {entry!r} "
+                    "(job and batch ordinals are 1-based)", stacklevel=2)
+                continue
+            slot(job)["batch"].setdefault(
+                ("dispatch", ordinal), []).append(m.group(1))
+        elif m.group(4):  # reject
+            job = int(m.group(5))
+            if job < 1:
+                warnings.warn(
+                    f"{SERVICE_FAULT_PLAN_ENV}: ignoring entry {entry!r} "
+                    "(job ordinals are 1-based)", stacklevel=2)
+                continue
+            slot(job)["reject"] = True
+        else:  # stall
+            job, sec = int(m.group(7)), float(m.group(8))
+            if job < 1:
+                warnings.warn(
+                    f"{SERVICE_FAULT_PLAN_ENV}: ignoring entry {entry!r} "
+                    "(job ordinals are 1-based)", stacklevel=2)
+                continue
+            slot(job)["stall_sec"] += sec
+    return plan
+
+
+def service_fault_plan_from_env() -> dict:
+    return parse_service_fault_plan(os.environ.get(SERVICE_FAULT_PLAN_ENV))
 
 
 def normalized_plan_repr(plan: dict) -> str:

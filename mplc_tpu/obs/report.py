@@ -30,7 +30,11 @@ quantities every perf PR needs as a measured before/after:
   - a trust row (seed-ensemble sweeps only): per-partner Shapley
     confidence intervals and the Kendall-tau rank-stability score from
     the `contrib.trust` event — so a reported ranking says how much the
-    seeds agree on it.
+    seeds agree on it;
+  - a service row (multi-tenant sweep-service runs): job outcomes
+    (completed/quarantined/cancelled/recovered), the cross-tenant
+    packed-batch count, and per-tenant fair-share cost attribution from
+    the `service.slice` spans' batch accounting.
 
 The report is derived from SPANS of the collected region only, so callers
 get a clean per-run view without resetting the process-global metrics
@@ -71,9 +75,11 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     fits = []
     retries = 0
     backoff_s = 0.0
-    cap_halvings = cpu_fallbacks = 0
+    cap_halvings = cpu_fallbacks = ladder_exhausted = 0
     cpu_batches = cpu_coalitions = 0
     faults_injected = 0
+    svc_tenants: dict = {}
+    svc_jobs: dict = {}
     trust = None
     per_method: dict = {}
     recon_batches = recon_coalitions = 0
@@ -169,13 +175,34 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             retries += 1
             backoff_s += float(a.get("backoff_sec", 0.0))
         elif name == "engine.degrade":
-            # every degrade event is one rung down the ladder; the last
-            # rung flips the engine onto the per-batch CPU path
-            cap_halvings += 1
-            if a.get("action") == "cpu_fallback":
-                cpu_fallbacks += 1
+            # every halve/fallback event is one rung down the ladder (the
+            # last rung flips the engine onto the per-batch CPU path);
+            # `ladder_exhausted` is the 2-D dead end — the classified
+            # terminal error where no CPU rung exists — and is NOT a rung
+            if a.get("action") == "ladder_exhausted":
+                ladder_exhausted += 1
+            else:
+                cap_halvings += 1
+                if a.get("action") == "cpu_fallback":
+                    cpu_fallbacks += 1
         elif name == "engine.fault":
             faults_injected += 1
+        elif name == "service.slice":
+            # one scheduling quantum of the sweep service: per-tenant
+            # batch/sample accounting for fair-share cost attribution
+            t = svc_tenants.setdefault(a.get("tenant", "?"), {
+                "slices": 0, "batches": 0, "coalitions": 0, "epochs": 0,
+                "samples": 0, "packed_batches": 0, "seconds": 0.0})
+            t["slices"] += 1
+            t["batches"] += int(a.get("batches", 0))
+            t["coalitions"] += int(a.get("coalitions", 0))
+            t["epochs"] += int(a.get("epochs", 0))
+            t["samples"] += int(a.get("samples", 0))
+            t["packed_batches"] += int(a.get("packed_batches", 0))
+            t["seconds"] += dur
+        elif name == "service.job":
+            # terminal job event (completed / quarantined / cancelled)
+            svc_jobs[a.get("job", "?")] = a
         elif name == "contrib.trust":
             # one trust row per sweep; the last event wins (a re-run of
             # the estimator within one collected region supersedes)
@@ -257,6 +284,10 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             "cpu_degraded": cpu_fallbacks > 0,
             "cpu_batches": cpu_batches,
             "cpu_coalitions": cpu_coalitions,
+            # 2-D ladder dead ends (LadderExhaustedError raised): the
+            # sweep could not make progress at any cap and had no CPU
+            # rung — under the service this quarantines one tenant's job
+            "ladder_exhausted": ladder_exhausted,
             "faults_injected": faults_injected,
         },
         "per_width": per_width,
@@ -315,6 +346,30 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
                 recon_coalitions / recon_s if recon_s else None,
             "train_partner_passes": partner_passes,
             "train_batches": batches - recon_batches,
+        }
+    if svc_tenants or svc_jobs:
+        # the multi-tenant service view: job outcomes, the cross-tenant
+        # program-packing win, and fair-share cost attribution — each
+        # tenant's share of the service's measured batch span-seconds
+        # (the per-batch accounting the ROADMAP item asked to reuse)
+        total_s = sum(t["seconds"] for t in svc_tenants.values())
+        by_status: dict = {}
+        for a in svc_jobs.values():
+            s = a.get("status", "?")
+            by_status[s] = by_status.get(s, 0) + 1
+        report["service"] = {
+            "jobs": len(svc_jobs),
+            "completed": by_status.get("completed", 0),
+            "quarantined": by_status.get("quarantined", 0),
+            "cancelled": by_status.get("cancelled", 0),
+            "recovered": sum(1 for a in svc_jobs.values()
+                             if a.get("recovered")),
+            "cross_tenant_packed_batches": sum(
+                t["packed_batches"] for t in svc_tenants.values()),
+            "per_tenant": {
+                name: {**t, "cost_share": (t["seconds"] / total_s
+                                           if total_s else None)}
+                for name, t in sorted(svc_tenants.items())},
         }
     if trust is not None:
         report["trust"] = trust
@@ -390,9 +445,30 @@ def format_report(report: dict) -> str:
                 f"cpu_batches={r['cpu_batches']}")
         if r.get("cpu_coalitions"):
             line += f"  cpu_coalitions={r['cpu_coalitions']}"
+        if r.get("ladder_exhausted"):
+            line += f"  ladder_exhausted={r['ladder_exhausted']}"
         if r.get("faults_injected"):
             line += f"  faults_injected={r['faults_injected']}"
         lines.append(line)
+    svc = report.get("service")
+    if svc is not None:
+        # the multi-tenant service view: outcomes + the packing win, then
+        # one fair-share line per tenant
+        lines.append(
+            f"  service     jobs={svc['jobs']}  "
+            f"completed={svc['completed']}  "
+            f"quarantined={svc['quarantined']}  "
+            f"cancelled={svc['cancelled']}  "
+            f"recovered={svc['recovered']}  "
+            f"packed_batches={svc['cross_tenant_packed_batches']}")
+        for name, t in (svc.get("per_tenant") or {}).items():
+            share = t.get("cost_share")
+            lines.append(
+                f"    tenant[{name}]  slices={t['slices']}  "
+                f"batches={t['batches']}  coalitions={t['coalitions']}  "
+                f"samples={t['samples']}  span={t['seconds']:.2f}s  "
+                "share="
+                + (f"{share:.1%}" if share is not None else "n/a"))
     rc = report.get("reconstruction")
     if rc is not None:
         mem = rc.get("recorded_update_bytes")

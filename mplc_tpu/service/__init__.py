@@ -1,0 +1,24 @@
+"""Sweep-as-a-service: fault-isolated multi-tenant sweep scheduling with
+journaled crash recovery (scheduler.py), cross-tenant program packing
+bookkeeping (packer.py) and the checksummed write-ahead journal
+(journal.py)."""
+
+from .journal import JournalCorruptError, SweepJournal
+from .packer import CrossTenantPacker
+from .scheduler import (JobCancelled, JobQuarantined, ServiceClosed,
+                        ServiceError, ServiceOverloaded, ServiceRejected,
+                        SweepJob, SweepService)
+
+__all__ = [
+    "CrossTenantPacker",
+    "JobCancelled",
+    "JobQuarantined",
+    "JournalCorruptError",
+    "ServiceClosed",
+    "ServiceError",
+    "ServiceOverloaded",
+    "ServiceRejected",
+    "SweepJob",
+    "SweepJournal",
+    "SweepService",
+]

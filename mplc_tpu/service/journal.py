@@ -1,0 +1,177 @@
+"""Write-ahead journal for the sweep service: crash recovery as replay.
+
+The engine's cache autosave (PR 4) makes ONE engine's sweep resumable;
+the service needs the same durability for a whole multi-tenant process —
+every accepted submission and every harvested `(tenant, subset, value)`
+must survive a hard kill so a restarted service completes every in-flight
+sweep bit-identically to an uninterrupted run.
+
+Format: JSONL, one record per line:
+
+    {"sha256": "<hex>", "rec": {...}}
+
+where the checksum covers the canonical serialization of `rec`
+(`json.dumps(rec, sort_keys=True)`) — the same corruption-is-detectable
+discipline as the engine's `save_cache`. Appends are flushed and fsync'd
+before `append` returns: a record the service acted on (a value it
+streamed to a tenant, a submission it acknowledged) is durable by the
+time anyone can observe the action.
+
+Replay distinguishes two failure shapes:
+
+  - a TORN TAIL — the final line fails to parse or checksum, the
+    signature of a kill mid-append. The bad bytes are quarantined to
+    `<path>.torn`, the journal is truncated back to the last good
+    record, and replay succeeds with everything before the tear (a
+    re-run of the torn record's batch is bit-identical, so nothing is
+    lost but one batch of work);
+  - MID-FILE corruption — a bad line with good records after it cannot
+    be a torn append; something rewrote history. That raises
+    `JournalCorruptError`: recovery code must quarantine the whole file
+    (or refuse to trust it), never silently skip interior records.
+
+Float values round-trip exactly through `json` (repr-based float
+serialization), so replayed v(S) tables are bit-identical to the
+harvested ones — the property the service's recovery invariant rests on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import warnings
+
+logger = logging.getLogger("mplc_tpu")
+
+
+class JournalCorruptError(ValueError):
+    """A journal record BEFORE the tail failed to parse or checksum —
+    not a torn append but rewritten/corrupted history. Distinct from the
+    torn-tail case, which replay quarantines and survives."""
+
+
+def _checksum(rec: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(rec, sort_keys=True).encode()).hexdigest()
+
+
+class SweepJournal:
+    """Append-only, checksummed, fsync'd journal (one writer at a time)."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._fh = None
+
+    def _handle(self):
+        if self._fh is None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "ab")
+        return self._fh
+
+    def append(self, rec: dict) -> None:
+        """Durably append one record: the line is flushed and fsync'd
+        before this returns, so anything the caller does AFTER (stream a
+        value, acknowledge a submission) is guaranteed replayable."""
+        self.append_many([rec])
+
+    def append_many(self, recs) -> None:
+        """One durability point for a whole batch of records: every line
+        is written, then ONE flush+fsync. Crash semantics are identical
+        to per-record appends — replay already tolerates a torn tail, and
+        losing a partially-written batch loses exactly the work a
+        per-record kill at the same instant would — at 1/N the fsync
+        cost, which matters because the scheduler journals every
+        harvested coalition of a batch at once."""
+        if not recs:
+            return
+        fh = self._handle()
+        for rec in recs:
+            fh.write(json.dumps(
+                {"sha256": _checksum(rec), "rec": rec}).encode() + b"\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # -- recovery --------------------------------------------------------
+
+    @classmethod
+    def replay(cls, path) -> tuple[list, bool]:
+        """`(records, tail_torn)` for an existing journal file.
+
+        Every good record's `rec` dict is returned in append order. A bad
+        FINAL line (parse failure or checksum mismatch — a torn append
+        from a mid-write kill) is quarantined to `<path>.torn`, the
+        journal is truncated back to the last good record, `tail_torn` is
+        True and a warning names the quarantine file. A bad line with
+        good records after it raises `JournalCorruptError`. A missing
+        file replays as an empty journal."""
+        path = str(path)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return [], False
+
+        records = []
+        good_end = 0  # byte offset just past the last good line
+        offset = 0
+        bad_at = None  # (byte offset, reason) of the first bad line
+        for line in raw.split(b"\n"):
+            line_end = offset + len(line) + 1  # +1 for the split "\n"
+            if line.strip():
+                reason = None
+                try:
+                    doc = json.loads(line)
+                    rec = doc["rec"]
+                    if _checksum(rec) != doc.get("sha256"):
+                        reason = "checksum mismatch"
+                except (ValueError, KeyError, TypeError) as e:
+                    reason = f"unparseable record ({e})"
+                if reason is not None:
+                    if bad_at is None:
+                        bad_at = (offset, reason)
+                else:
+                    if bad_at is not None:
+                        # a good record AFTER a bad one: not a torn
+                        # append — history itself is corrupt
+                        raise JournalCorruptError(
+                            f"journal {path} has a corrupt record at byte "
+                            f"{bad_at[0]} ({bad_at[1]}) followed by valid "
+                            "records — this is not a torn tail; refusing "
+                            "to replay selectively")
+                    records.append(rec)
+                    good_end = min(line_end, len(raw))
+            offset = line_end
+
+        if bad_at is None:
+            return records, False
+
+        # torn tail: quarantine the bad bytes, truncate back to the last
+        # good record, and carry on — one interrupted append must never
+        # cost the journal's whole history
+        torn = raw[bad_at[0]:]
+        torn_path = path + ".torn"
+        with open(torn_path, "wb") as f:
+            f.write(torn)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(path, "r+b") as f:
+            f.truncate(good_end)
+            f.flush()
+            os.fsync(f.fileno())
+        from ..obs import metrics as obs_metrics
+        obs_metrics.counter("service.journal_torn_records").inc()
+        warnings.warn(
+            f"sweep journal {path} ended in a torn record "
+            f"({bad_at[1]}; the kill landed mid-append) — {len(torn)} "
+            f"bytes quarantined to {torn_path}, journal truncated to the "
+            f"last good record ({len(records)} records replayed)",
+            stacklevel=2)
+        return records, True

@@ -1,0 +1,768 @@
+"""Sweep-as-a-service: the multi-tenant scheduler.
+
+A `SweepService` is a long-lived process-level engine front end: tenants
+submit `Scenario` + method jobs onto a bounded queue and get a `SweepJob`
+handle that streams per-coalition values back incrementally and resolves
+to the method's contributivity scores. One worker thread round-robins a
+scheduling quantum ("slice") across active jobs, so many concurrent
+contributivity games share one process, one device pool and one program
+bank without any tenant monopolizing the device.
+
+The headline is the fault model, not the queue:
+
+  **Per-tenant fault isolation.** Every job runs on its own private
+  `CharacteristicEngine` — private memo, private retry/degrade ladder,
+  private fault injector — so a transient error, OOM, fault-plan
+  injection or outright crash attributable to tenant A re-buckets/retries
+  only A's batches and can never numerically perturb tenant B: B's values
+  are bit-identical to a solo-engine run of the same scenario
+  (equality-tested in tests/test_service.py). What tenants SHARE is the
+  compiled-program bank, in its shape-scoped mode (`ProgramBank
+  shared=True`): same `(slots, width)` bucket => same banked executable
+  regardless of which game a subset came from, so a second tenant of the
+  same shape compiles nothing (`service.cross_tenant_packed_batches`
+  counts the batches that rode another tenant's programs). A job whose
+  attempt dies with a retryable failure (transient, OOM that escaped the
+  engine ladder, injected crash) is re-queued — its harvested values
+  persist in the engine memo and the journal, so the continuation is
+  bit-identical — and quarantined after `MPLC_TPU_MAX_RETRIES` failed
+  attempts instead of retrying forever. Permanent failures (a classified
+  `LadderExhaustedError`, a genuine bug) quarantine immediately.
+
+  **Admission control and deadlines.** The queue is bounded
+  (`MPLC_TPU_SERVICE_MAX_PENDING`): past the bound, `submit` raises
+  `ServiceOverloaded` — a clean, synchronous backpressure signal, never a
+  silent drop. A per-job `deadline_sec` is enforced cooperatively at
+  every batch boundary (the engine's progress hook) and every quantum
+  boundary: an expired job raises `JobCancelled` between batches — no
+  in-flight dispatch is abandoned mid-device — its engine is dropped (the
+  only references to its device buffers), and the cancellation is
+  journaled. `shutdown(drain=True)` stops admissions and completes every
+  queued job before returning.
+
+  **Journaled crash recovery.** When constructed with a `journal_path`,
+  every accepted submission and every harvested `(tenant, subset, value)`
+  is appended to a checksummed, fsync'd write-ahead journal
+  (service/journal.py) BEFORE the service acts on it. A killed process
+  restarts by constructing a new service on the same path: the journal
+  replays (quarantining a torn tail record), `recovered_jobs()` lists the
+  interrupted submissions, and re-submitting a scenario under its old
+  `job_id` seeds the fresh engine's memo with every journaled value — the
+  sweep completes training only what was never harvested, bit-identically
+  to an uninterrupted run (same per-coalition rng-fold streams; the
+  engine's batch composition never affects v(S)).
+
+Deterministic testability: `MPLC_TPU_SERVICE_FAULT_PLAN` (faults.py)
+addresses jobs by submission ordinal — `crash@job2:batch3` installs an
+injected crash into job 2's private engine injector, `reject@job4` makes
+admission refuse the 4th submission, `stall@job1:sec2` sleeps the
+scheduler before job 1's next quantum (billed against job 1's own
+deadline; with a single shared device, a stalled tenant's compute slot is
+indistinguishable from slow compute for whoever is behind it in line).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from .. import constants, faults
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from .journal import SweepJournal
+from .packer import CrossTenantPacker
+
+logger = logging.getLogger("mplc_tpu")
+
+
+class ServiceError(RuntimeError):
+    """Base class for service-level failures."""
+
+
+class ServiceClosed(ServiceError):
+    """submit() after shutdown started."""
+
+
+class ServiceOverloaded(ServiceError):
+    """Backpressure: the bounded submission queue is full. Resubmit after
+    draining — nothing about the request itself is wrong."""
+
+
+class ServiceRejected(ServiceError):
+    """Admission control refused the job (fault-plan injected reject)."""
+
+
+class JobQuarantined(ServiceError):
+    """The job exhausted its retry budget (or failed permanently) and was
+    quarantined; `__cause__` carries the terminal error."""
+
+
+class JobCancelled(Exception):
+    """Raised cooperatively at a batch/quantum boundary when a job's
+    deadline expired. Plain Exception (not ServiceError): it unwinds
+    through the engine's recovery ladder untouched (`is_transient` /
+    `is_oom` are both False for it)."""
+
+
+class SweepJob:
+    """Handle for one submitted job. Thread-safe consumer surface:
+    `stream()` yields `(subset, value)` incrementally as batches harvest,
+    `result()` blocks for the final contributivity scores."""
+
+    def __init__(self, service, job_id, tenant, scenario, method,
+                 deadline_sec, ordinal):
+        self.service = service
+        self.job_id = job_id
+        self.tenant = tenant
+        self.scenario = scenario
+        self.method = method
+        self.deadline_sec = deadline_sec
+        self.ordinal = ordinal  # 1-based submission ordinal (fault plan)
+        self.status = "queued"
+        self.engine = None
+        self.subsets = None
+        self.attempts = 0
+        self.recovered_values = 0
+        self.packed_batches = 0
+        self.scores = None
+        # the completed job's full v(S) table (host-side floats), stashed
+        # at completion so the engine's device state can be released
+        self.values: "dict | None" = None
+        self.error: "BaseException | None" = None
+        self.submitted_at = time.monotonic()
+        self._done = threading.Event()
+        self._journal_cursor = 0    # items of charac_fct_values journaled
+        self._cancel_raised = False
+        self._slice_packed: dict = {}
+        self._stream: list = []     # [(subset, value)] in harvest order
+        self._stream_lock = threading.Condition()
+
+    # -- consumer surface ------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: "float | None" = None):
+        """Block for the job's contributivity scores; raises the job's
+        terminal error (JobQuarantined / JobCancelled) on failure."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"job {self.job_id} not finished within {timeout} s "
+                f"(status={self.status})")
+        if self.error is not None:
+            raise self.error
+        return self.scores
+
+    def stream(self, timeout: "float | None" = None):
+        """Yield `(subset, value)` pairs as they are harvested, ending
+        when the job reaches a terminal state. Values arrive in journal
+        order; a consumer that starts late still sees every pair."""
+        i = 0
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._stream_lock:
+                while i >= len(self._stream) and not self._done.is_set():
+                    wait = (None if deadline is None
+                            else max(deadline - time.monotonic(), 0.0))
+                    if wait == 0.0:
+                        raise TimeoutError(
+                            f"job {self.job_id} stream stalled")
+                    self._stream_lock.wait(wait)
+                if i < len(self._stream):
+                    item = self._stream[i]
+                else:
+                    return
+            yield item
+            i += 1
+
+    # -- service-side helpers -------------------------------------------
+
+    def _push_stream(self, items) -> None:
+        with self._stream_lock:
+            self._stream.extend(items)
+            self._stream_lock.notify_all()
+
+    def _finish(self) -> None:
+        with self._stream_lock:
+            self._stream_lock.notify_all()
+        self._done.set()
+
+    def _deadline_expired(self) -> bool:
+        return (self.deadline_sec is not None
+                and time.monotonic() - self.submitted_at > self.deadline_sec)
+
+
+class SweepService:
+    """The long-lived multi-tenant sweep scheduler (module docstring)."""
+
+    def __init__(self, journal_path=None, max_pending: "int | None" = None,
+                 slice_coalitions: "int | None" = None, start: bool = True):
+        self._lock = threading.Condition()
+        self._queue: deque = deque()
+        self._jobs: dict = {}
+        self._ordinal = 0
+        self._closed = False
+        self._running_job = None
+        self._worker = None
+        self._packer = CrossTenantPacker()
+        self._plan = faults.service_fault_plan_from_env()
+        self._max_pending = (max_pending if max_pending is not None
+                             else constants._env_positive_int(
+                                 constants.SERVICE_MAX_PENDING_ENV, 32))
+        self._slice = (slice_coalitions if slice_coalitions is not None
+                       else constants._env_positive_int(
+                           constants.SERVICE_SLICE_ENV, 16))
+        self._max_job_retries = constants._env_positive_int(
+            constants.MAX_RETRIES_ENV, 3)
+
+        # journal replay BEFORE the append handle opens: a restart reads
+        # history (quarantining a torn tail), then appends to it
+        self._journal = None
+        self._journal_broken = False
+        # terminal jobs retained for handle lookups, FIFO-bounded so a
+        # long-lived service's _jobs map can't grow without bound (the
+        # caller's own handle keeps an evicted job alive)
+        self._terminal_order: deque = deque()
+        self._max_terminal_jobs = 256
+        self._recovered: dict = {}
+        if journal_path is not None:
+            records, _torn = SweepJournal.replay(journal_path)
+            for rec in records:
+                self._replay_record(rec)
+            self._journal = SweepJournal(journal_path)
+
+        if start:
+            self._worker = threading.Thread(
+                target=self._worker_loop, daemon=True,
+                name="mplc-sweep-service")
+            self._worker.start()
+
+    # -- recovery --------------------------------------------------------
+
+    def _replay_record(self, rec: dict) -> None:
+        kind = rec.get("type")
+        job = rec.get("job")
+        if kind == "submit":
+            slot = self._recovered.setdefault(
+                job, {"values": {}, "done": False, "quarantined": False,
+                      "cancelled": False})
+            # a resubmission after a previous restart re-journals the
+            # submit record: MERGE (keep already-replayed values)
+            slot.update(tenant=rec.get("tenant"), method=rec.get("method"),
+                        partners_count=rec.get("partners_count"))
+        elif kind == "value" and job in self._recovered:
+            self._recovered[job]["values"][
+                tuple(rec["subset"])] = rec["value"]
+        elif kind == "done" and job in self._recovered:
+            self._recovered[job]["done"] = True
+        elif kind == "quarantine" and job in self._recovered:
+            self._recovered[job]["quarantined"] = True
+        elif kind == "cancel" and job in self._recovered:
+            self._recovered[job]["cancelled"] = True
+
+    def recovered_jobs(self) -> list:
+        """Descriptors of journaled submissions from previous service
+        lives: `[{job_id, tenant, method, values, done, ...}]`. Resubmit
+        each unfinished one with its old `job_id` to complete it — the
+        engine memo is seeded from the journaled values, so only
+        never-harvested coalitions train."""
+        return [{"job_id": jid, "tenant": r.get("tenant"),
+                 "method": r.get("method"), "values": len(r["values"]),
+                 "done": r["done"], "quarantined": r["quarantined"],
+                 "cancelled": r["cancelled"]}
+                for jid, r in self._recovered.items()]
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, scenario, method: str = "Shapley values",
+               tenant: str = "tenant0",
+               deadline_sec: "float | None" = None,
+               job_id: "str | None" = None) -> SweepJob:
+        """Accept a Scenario+method job onto the bounded queue.
+
+        Raises `ServiceClosed` after shutdown, `ServiceOverloaded` when
+        the queue is at `MPLC_TPU_SERVICE_MAX_PENDING` (backpressure —
+        resubmit later), `ServiceRejected` on a fault-plan injected
+        admission reject. The accepted submission is journaled before
+        this returns."""
+        if method not in constants.CONTRIBUTIVITY_METHODS:
+            # validated synchronously: the dispatcher would only log a
+            # warning for an unknown name, and a job that "completes"
+            # with no scores is worse than a clean submit-time error
+            raise ValueError(
+                f"unknown contributivity method {method!r} (expected one "
+                f"of {constants.CONTRIBUTIVITY_METHODS})")
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed("service is shut down")
+            self._ordinal += 1
+            ordinal = self._ordinal
+            entry = self._plan.get(ordinal)
+            if entry is not None and entry.get("reject"):
+                obs_metrics.counter("service.jobs_rejected").inc()
+                obs_trace.event("service.reject", tenant=tenant,
+                                ordinal=ordinal, reason="fault_plan")
+                raise ServiceRejected(
+                    f"admission control rejected submission #{ordinal} "
+                    f"({faults.SERVICE_FAULT_PLAN_ENV} reject entry)")
+            pending = sum(1 for j in self._jobs.values() if not j.done)
+            if pending >= self._max_pending:
+                obs_metrics.counter("service.jobs_rejected").inc()
+                obs_trace.event("service.reject", tenant=tenant,
+                                ordinal=ordinal, reason="backpressure")
+                raise ServiceOverloaded(
+                    f"submission queue is full ({pending} pending >= "
+                    f"{constants.SERVICE_MAX_PENDING_ENV}="
+                    f"{self._max_pending}); resubmit after jobs drain")
+            if job_id is None:
+                job_id = f"job{ordinal}"
+            if job_id in self._jobs:
+                raise ValueError(f"job id {job_id!r} already submitted "
+                                 "to this service")
+            job = SweepJob(self, job_id, tenant, scenario, method,
+                           deadline_sec, ordinal)
+            if self._journal is not None:
+                # journal BEFORE registering: an un-journalable
+                # submission must fail synchronously (the caller is owed
+                # the durability contract), never become a phantom job
+                # that occupies a MAX_PENDING slot forever
+                if self._journal_broken:
+                    raise ServiceError(
+                        "the service WAL is broken (an earlier append "
+                        "failed); refusing new submissions whose "
+                        "durability cannot be honored — in-flight jobs "
+                        "continue without recovery coverage")
+                try:
+                    self._journal.append({
+                        "type": "submit", "job": job_id, "tenant": tenant,
+                        "method": method,
+                        "partners_count": int(scenario.partners_count)})
+                except OSError as e:
+                    raise ServiceError(
+                        f"could not journal submission {job_id!r}: "
+                        f"{e}") from e
+            self._jobs[job_id] = job
+            obs_metrics.counter("service.jobs_accepted").inc()
+            obs_trace.event("service.submit", tenant=tenant, job=job_id,
+                            method=method, ordinal=ordinal)
+            self._queue.append(job)
+            self._lock.notify_all()
+        return job
+
+    # -- scheduling loop -------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue and not self._closed:
+                    self._lock.wait()
+                if not self._queue:
+                    return  # closed and drained
+                job = self._queue.popleft()
+                self._running_job = job
+            alive = False
+            try:
+                alive = self._run_quantum(job)
+            finally:
+                # clear running AND re-queue under ONE lock hold: a
+                # drain() between the two would otherwise see an idle
+                # service with a live job in neither place
+                with self._lock:
+                    self._running_job = None
+                    if alive and not job.done:
+                        self._queue.append(job)  # round-robin re-queue
+                    self._lock.notify_all()
+
+    def step(self) -> bool:
+        """Process ONE scheduling quantum inline (start=False mode — the
+        deterministic harness the crash-recovery tests drive). Returns
+        True while work remains."""
+        with self._lock:
+            if not self._queue:
+                return False
+            job = self._queue.popleft()
+        alive = self._run_quantum(job)
+        with self._lock:
+            if alive and not job.done:
+                self._queue.append(job)
+            return bool(self._queue)
+
+    def run_until_idle(self) -> None:
+        """Drain the queue inline (start=False mode)."""
+        while self.step():
+            pass
+
+    def drain(self, timeout: "float | None" = None) -> None:
+        """Block until every accepted job reached a terminal state."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if self._worker is None:
+            self.run_until_idle()
+            return
+        with self._lock:
+            while self._queue or self._running_job is not None:
+                wait = (None if deadline is None
+                        else max(deadline - time.monotonic(), 0.0))
+                if wait == 0.0:
+                    raise TimeoutError("service did not drain in time")
+                self._lock.wait(wait)
+
+    def shutdown(self, drain: bool = True,
+                 timeout: "float | None" = None) -> None:
+        """Stop accepting submissions; with `drain` (the default) finish
+        every queued job first, otherwise cancel whatever never started.
+        Idempotent; closes the journal last."""
+        with self._lock:
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    job = self._queue.popleft()
+                    self._terminal(job, "cancelled",
+                                   JobCancelled("service shutdown"))
+            self._lock.notify_all()
+        if drain:
+            self.drain(timeout)
+        if self._worker is not None:
+            self._worker.join(timeout)
+            self._worker = None
+        if self._journal is not None:
+            self._journal.close()
+
+    def __enter__(self) -> "SweepService":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.shutdown(drain=exc == (None, None, None))
+        return False
+
+    # -- one scheduling quantum ------------------------------------------
+
+    def _run_quantum(self, job: SweepJob) -> bool:
+        """Run one slice of `job`. Returns True when the job should be
+        re-queued (work remains), False on any terminal state. EVERY
+        failure is contained here: nothing a job does may unwind into
+        the scheduler loop (per-tenant isolation)."""
+        entry = self._plan.get(job.ordinal)
+        if entry is not None and entry.get("stall_sec"):
+            sec, entry["stall_sec"] = entry["stall_sec"], 0.0
+            obs_trace.event("service.stall", tenant=job.tenant,
+                            job=job.job_id, seconds=sec)
+            logger.warning("service: injected stall of %.2f s before %s",
+                           sec, job.job_id)
+            time.sleep(sec)
+        if job._deadline_expired():
+            self._terminal(job, "cancelled", JobCancelled(
+                f"job {job.job_id} exceeded deadline_sec="
+                f"{job.deadline_sec} before its quantum"))
+            return False
+        job.status = "running"
+        span = obs_trace.start_span("service.slice", tenant=job.tenant,
+                                    job=job.job_id)
+        try:
+            if job.engine is None:
+                self._build_engine(job)
+            eng = job.engine
+            b0, e0 = eng._batch_ordinal, eng.epochs_trained
+            s0, p0 = eng.samples_trained, job.packed_batches
+            c0 = len(eng.charac_fct_values)
+            if job.method == "Shapley values":
+                finished = self._run_exact_slice(job)
+            else:
+                finished = self._run_method_quantum(job)
+            span.attrs.update(
+                batches=eng._batch_ordinal - b0,
+                coalitions=len(eng.charac_fct_values) - c0,
+                epochs=eng.epochs_trained - e0,
+                samples=eng.samples_trained - s0,
+                packed_batches=job.packed_batches - p0)
+            span.end()
+            if finished:
+                self._complete(job)
+                return False
+            return True
+        except JobCancelled as e:
+            span.cancel()
+            self._journal_new_values(job)  # keep what the drain harvested
+            self._terminal(job, "cancelled", e)
+            return False
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — the isolation boundary
+            span.cancel()
+            # preserve whatever the failed attempt harvested before the
+            # fault: the journal (and the engine memo) make the retry a
+            # bit-identical continuation, not a restart
+            try:
+                self._journal_new_values(job)
+            except Exception:
+                logger.exception(
+                    "service: journaling after a fault failed for %s",
+                    job.job_id)
+            return self._fail_attempt(job, e)
+
+    def _fail_attempt(self, job: SweepJob, err: BaseException) -> bool:
+        """Attempt-level retry/quarantine policy. Retryable failures
+        (transient-classified, OOM that escaped the engine's own ladder,
+        injected crash) re-queue the job up to MPLC_TPU_MAX_RETRIES
+        attempts; permanent ones (LadderExhaustedError, genuine bugs)
+        quarantine immediately — a poison job must never retry forever."""
+        job.attempts += 1
+        retryable = (faults.is_transient(err) or faults.is_oom(err)
+                     or isinstance(err, faults.InjectedCrash))
+        obs_trace.event("service.job_fault", tenant=job.tenant,
+                        job=job.job_id, attempt=job.attempts,
+                        retryable=retryable, error=str(err)[:200])
+        if retryable and job.attempts <= self._max_job_retries:
+            logger.warning(
+                "service: job %s attempt %d failed (%s) — re-queueing "
+                "(its harvested values persist; the continuation is "
+                "bit-identical)", job.job_id, job.attempts, err)
+            return True
+        kind = ("retry budget exhausted" if retryable
+                else "permanent failure")
+        logger.error("service: quarantining job %s after %s: %s",
+                     job.job_id, kind, err)
+        q = JobQuarantined(
+            f"job {job.job_id} quarantined ({kind}, "
+            f"{job.attempts} attempt(s)): {err}")
+        # __cause__ accepts any BaseException — the injected-crash case
+        # must not be the one place the root cause is lost
+        q.__cause__ = err
+        self._terminal(job, "quarantined", q)
+        return False
+
+    # -- engine lifecycle ------------------------------------------------
+
+    def _build_engine(self, job: SweepJob) -> None:
+        from ..contrib.bank import ProgramBank
+        from ..contrib.engine import CharacteristicEngine
+        from ..contrib.shapley import powerset_order
+
+        eng = CharacteristicEngine(job.scenario)
+        if eng.program_bank is not None:
+            # shape-scoped keys: same (slots, width) bucket => same banked
+            # program regardless of which tenant's game it serves
+            eng.program_bank = ProgramBank(eng, shared=True)
+        entry = self._plan.get(job.ordinal)
+        if entry is not None and entry.get("batch"):
+            # install the job's injected batch faults into ITS engine's
+            # private injector: FaultInjector's fire-once/retry-keeps-
+            # ordinal semantics apply per tenant, exactly as solo
+            eng._faults = faults.FaultInjector(
+                {k: list(v) for k, v in entry["batch"].items()})
+
+        def on_batch(done_in_group, remaining, slot_count,
+                     _job=job) -> None:
+            self._on_batch(_job, slot_count)
+
+        eng.progress = on_batch
+        job.engine = eng
+        job.subsets = powerset_order(eng.partners_count)
+
+        rec = self._recovered.get(job.job_id)
+        if rec and rec["values"]:
+            # the journaled submission is the authority on which GAME the
+            # job_id names: seeding a different scenario's engine from it
+            # would silently mix two games' v(S) tables
+            jp = rec.get("partners_count")
+            if jp is not None and int(jp) != eng.partners_count:
+                raise ValueError(
+                    f"journaled job {job.job_id!r} was submitted with "
+                    f"{jp} partners but the resubmitted scenario has "
+                    f"{eng.partners_count} — refusing to seed v(S) from "
+                    "a different game's journal (resubmit the original "
+                    "scenario, or use a fresh job_id)")
+            # seed the fresh engine's memo from the journal: replay in
+            # journal (= harvest) order reproduces the increment
+            # bookkeeping of the original run, and the journaled floats
+            # round-trip exactly — the continuation is bit-identical
+            for subset, value in rec["values"].items():
+                if subset and subset not in eng.charac_fct_values:
+                    eng._store(subset, float(value))
+            job.recovered_values = len(rec["values"])
+            job._journal_cursor = len(eng.charac_fct_values)
+            job._push_stream([(s, v) for s, v in rec["values"].items()])
+            obs_metrics.counter("service.jobs_recovered").inc()
+            obs_trace.event("service.recover", tenant=job.tenant,
+                            job=job.job_id, values=job.recovered_values)
+            # the seeded table now lives in the engine memo; a duplicate
+            # job_id can't be resubmitted in this service life, so free
+            # the replayed copy (a restart on a long journal must not pin
+            # every historical job's 2^P-entry table twice). Entries for
+            # jobs never resubmitted keep theirs until process exit —
+            # WAL compaction is future work.
+            rec["values"] = {}
+        else:
+            # the engine pre-seeds v(empty)=0; never journal it
+            job._journal_cursor = len(eng.charac_fct_values)
+
+    def _on_batch(self, job: SweepJob, slot_count) -> None:
+        """The engine's per-batch progress hook: journal what the batch
+        harvested, count cross-tenant packed batches, and enforce the
+        deadline cooperatively — raising BETWEEN batches, never inside a
+        dispatch."""
+        self._journal_new_values(job)
+        if job._slice_packed.get(slot_count):
+            job.packed_batches += 1
+            obs_metrics.counter("service.cross_tenant_packed_batches").inc()
+        if job._deadline_expired() and not job._cancel_raised:
+            # raise ONCE: the engine's exception-unwind drain re-enters
+            # this hook for the in-flight batch, and a second raise there
+            # would abort the drain's bookkeeping
+            job._cancel_raised = True
+            raise JobCancelled(
+                f"job {job.job_id} exceeded deadline_sec="
+                f"{job.deadline_sec} (cancelled at a batch boundary)")
+
+    def _journal_safe(self, *recs) -> None:
+        """Async-path WAL appends (harvest values, terminal states): a
+        journal write failure here (disk full, dead volume) must DEGRADE
+        the service — recovery coverage stops, loudly — never unwind into
+        the scheduler loop and kill the worker with jobs still blocked on
+        their handles. (submit() is the synchronous path and propagates
+        instead: an unacknowledged durability contract is the caller's to
+        handle.)"""
+        if self._journal is None or self._journal_broken:
+            return
+        try:
+            self._journal.append_many(list(recs))
+        except OSError as e:
+            self._journal_broken = True
+            obs_trace.event("service.journal_broken", error=str(e)[:200])
+            logger.error(
+                "service: WAL append failed (%s) — journaling DISABLED; "
+                "crash recovery no longer covers work from this point on",
+                e)
+
+    def _journal_new_values(self, job: SweepJob) -> None:
+        """Append every not-yet-journaled `(tenant, subset, value)` to the
+        WAL — one fsync for the whole batch — and the tenant's stream, in
+        memo insertion (= harvest) order."""
+        eng = job.engine
+        if eng is None:
+            return
+        items = list(eng.charac_fct_values.items())
+        fresh = items[job._journal_cursor:]
+        if not fresh:
+            return
+        job._journal_cursor = len(items)
+        self._journal_safe(*[
+            {"type": "value", "job": job.job_id, "tenant": job.tenant,
+             "subset": list(subset), "value": float(value)}
+            for subset, value in fresh])
+        job._push_stream(fresh)
+
+    # -- the two execution shapes ---------------------------------------
+
+    def _run_exact_slice(self, job: SweepJob) -> bool:
+        """One slice of an exact-Shapley sweep: evaluate the next
+        `MPLC_TPU_SERVICE_SLICE` missing coalitions. Returns True when
+        the sweep's table is complete."""
+        eng = job.engine
+        missing = [s for s in job.subsets
+                   if s not in eng.charac_fct_values]
+        if missing:
+            chunk = missing[:self._slice]
+            # the chunk is all-missing, so sweep_plan == the buckets
+            # evaluate() will actually dispatch
+            job._slice_packed = self._packer.observe_plan(
+                job.tenant, eng, eng.sweep_plan(chunk))
+            eng.evaluate(chunk)
+            self._journal_new_values(job)
+        return len(missing) <= self._slice
+
+    def _run_method_quantum(self, job: SweepJob) -> bool:
+        """Estimator methods (TMCS, GTG-Shapley, ...) drive the engine
+        from their own host loop, so they run as ONE quantum: the worker
+        is theirs for the method's duration, but per-batch journaling,
+        deadline cancellation and fault isolation all still apply through
+        the engine hooks."""
+        from ..contrib.contributivity import Contributivity
+
+        eng = job.engine
+        job._slice_packed = self._packer.observe_plan(
+            job.tenant, eng, eng.sweep_plan(job.subsets))
+        job.scenario._charac_engine = eng
+        contrib = Contributivity(job.scenario)
+        contrib.compute_contributivity(job.method)
+        self._journal_new_values(job)
+        job.scores = np.asarray(contrib.contributivity_scores)
+        return True
+
+    # -- terminal states -------------------------------------------------
+
+    def _release_engine_data(self, job: SweepJob) -> None:
+        """Drop the completed job's device-resident state (stacked data,
+        eval sets, pipelines, bank view) while KEEPING the engine object
+        and its host-side v(S)/counters for the handle's consumers — a
+        long-lived service completing many jobs must not accumulate one
+        game's device arrays per job."""
+        eng = job.engine
+        if eng is None:
+            return
+        eng.progress = None
+        for attr in ("stacked", "val", "test", "_cpu_data", "multi_pipe",
+                     "single_pipe", "_pipe2d", "program_bank"):
+            setattr(eng, attr, None)
+        eng._slot_pipes = {}
+        eng._singles_pipes = {}
+
+    def _retire(self, job: SweepJob) -> None:
+        """FIFO-bound the terminal-job registry: handles returned to
+        callers stay alive through their own reference, but the service's
+        _jobs map (and its job-id dedupe window) is bounded."""
+        with self._lock:
+            self._terminal_order.append(job.job_id)
+            while len(self._terminal_order) > self._max_terminal_jobs:
+                old = self._terminal_order.popleft()
+                j = self._jobs.get(old)
+                if j is not None and j.done:
+                    self._jobs.pop(old, None)
+
+    def _complete(self, job: SweepJob) -> None:
+        if job.scores is None:
+            from ..contrib.shapley import shapley_from_characteristic
+            job.scores = shapley_from_characteristic(
+                job.engine.partners_count, job.engine.charac_fct_values)
+        job.values = dict(job.engine.charac_fct_values)
+        job.status = "completed"
+        self._journal_safe({"type": "done", "job": job.job_id})
+        obs_metrics.counter("service.jobs_completed").inc()
+        obs_trace.event(
+            "service.job", tenant=job.tenant, job=job.job_id,
+            status="completed", attempts=job.attempts,
+            recovered=job.recovered_values > 0,
+            packed_batches=job.packed_batches,
+            seconds=time.monotonic() - job.submitted_at)
+        self._release_engine_data(job)
+        self._retire(job)
+        job._finish()
+
+    def _terminal(self, job: SweepJob, status: str,
+                  err: BaseException) -> None:
+        job.status = status
+        job.error = err
+        # the engine holds the only references to the job's device
+        # buffers (stacked data, eval sets, any banked-state leftovers):
+        # dropping it here is what "cancelled without leaking device
+        # buffers" means
+        job.engine = None
+        kind = "cancel" if status == "cancelled" else "quarantine"
+        self._journal_safe({"type": kind, "job": job.job_id,
+                            "error": str(err)[:500]})
+        counter = ("service.jobs_cancelled" if status == "cancelled"
+                   else "service.jobs_quarantined")
+        obs_metrics.counter(counter).inc()
+        obs_trace.event(
+            "service.job", tenant=job.tenant, job=job.job_id,
+            status=status, attempts=job.attempts,
+            recovered=job.recovered_values > 0,
+            packed_batches=job.packed_batches,
+            seconds=time.monotonic() - job.submitted_at,
+            error=str(err)[:200])
+        self._retire(job)
+        job._finish()

@@ -186,6 +186,14 @@ def load_telemetry_hbm(path):
     return _telemetry_row(path, "hbm")
 
 
+def load_telemetry_service(path):
+    """The multi-tenant service row (BENCH_CONFIG=6): job outcomes,
+    cross-tenant packed batches, and per-tenant fair-share cost
+    attribution. Single-tenant runs (and pre-service schemas) load as
+    {}."""
+    return _telemetry_row(path, "service")
+
+
 def parse_batch_times(log_path):
     """Per-slot-size batch durations (s), from either input kind:
 
@@ -454,6 +462,25 @@ def main():
                   f"{h.get('cap_after_donation', '?')} "
                   f"(effective {h.get('cap_effective', '?')}) — widths in "
                   "the schedule below assume the effective cap")
+        svc = load_telemetry_service(args.telemetry)
+        if svc.get("jobs"):
+            # multi-tenant service sidecars: whether the cross-tenant
+            # program packing actually fired (packed=0 on a two-tenant
+            # run means the shapes differed and every tenant compiled its
+            # own programs — a projection from it overstates the
+            # steady-state multi-tenant rate), plus how the measured
+            # span-seconds split across tenants
+            shares = ", ".join(
+                f"{name}={100 * (t.get('cost_share') or 0):.0f}%"
+                for name, t in (svc.get("per_tenant") or {}).items())
+            print(f"measured service: jobs={svc['jobs']} "
+                  f"completed={svc.get('completed', 0)} "
+                  f"quarantined={svc.get('quarantined', 0)} "
+                  f"cancelled={svc.get('cancelled', 0)} "
+                  f"packed_batches={svc.get('cross_tenant_packed_batches', 0)}"
+                  + (f" cost_share[{shares}]" if shares else "")
+                  + " — multi-tenant run: per-batch times below include "
+                    "scheduler slicing and per-value journal fsyncs")
         t = load_telemetry_trust(args.telemetry)
         if t.get("ensemble"):
             # the sweep's answer-trust view (absent in single-seed,

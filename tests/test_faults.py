@@ -11,6 +11,7 @@ memo cache."""
 
 import json
 import os
+import warnings
 
 import numpy as np
 import pytest
@@ -119,6 +120,42 @@ def test_error_classifier():
     # the crash class is a BaseException: recovery code catching
     # Exception can never swallow it
     assert not isinstance(faults.InjectedCrash("kill"), Exception)
+
+
+def test_transient_status_table_covers_service_layer_timeouts():
+    """gRPC DEADLINE_EXCEEDED / UNAVAILABLE classify transient REGARDLESS
+    of exception class: service-layer timeouts surface as plain
+    RuntimeError/OSError on toolchains without the XlaRuntimeError
+    symbol, and must ride the retry ladder instead of failing jobs.
+    Other plain-exception messages stay non-transient (host bugs)."""
+    for status in faults._TRANSIENT_STATUS:
+        assert status in ("DEADLINE_EXCEEDED", "UNAVAILABLE")
+        for cls in (RuntimeError, OSError, ConnectionError):
+            assert faults.is_transient(cls(f"{status}: rpc timed out")), \
+                (cls, status)
+        # leading whitespace tolerated (lstrip'd, like the XLA statuses)
+        assert faults.is_transient(RuntimeError(f"  {status}: x"))
+    # the status must LEAD the message — a mention mid-sentence is not a
+    # status code
+    assert not faults.is_transient(
+        RuntimeError("got error DEADLINE_EXCEEDED somewhere"))
+    # ... and must be the whole TOKEN: a longer identifier that merely
+    # starts with a status name is an application error, not a status
+    assert not faults.is_transient(
+        RuntimeError("UNAVAILABLE_RESOURCE: config bug"))
+    assert not faults.is_transient(
+        RuntimeError("DEADLINE_EXCEEDED2: odd custom error"))
+    assert faults.is_transient(RuntimeError("UNAVAILABLE"))  # bare status
+    # permanent statuses on plain exceptions stay permanent
+    assert not faults.is_transient(RuntimeError("INVALID_ARGUMENT: x"))
+    # a BaseException is never transient even with a transient status
+    assert not faults.is_transient(
+        faults.InjectedCrash("DEADLINE_EXCEEDED: kill"))
+    # the classified ladder-exhaustion error is PERMANENT by construction
+    err = faults.LadderExhaustedError("device OOM persisted", halvings=3)
+    assert not faults.is_transient(err)
+    assert not faults.is_oom(err)
+    assert err.halvings == 3 and err.mode == "2d"
 
 
 # -- transient retry ---------------------------------------------------------
@@ -402,20 +439,91 @@ def test_bitflipped_cache_fails_checksum_never_poisons_vs(tmp_path):
         fresh.load_cache(path)
 
 
-def test_legacy_cache_without_checksum_still_loads(tmp_path):
+def test_legacy_cache_without_checksum_still_loads(tmp_path, monkeypatch):
+    import mplc_tpu.contrib.engine as engine_mod
     from test_contrib import additive, fake_scenario
 
+    monkeypatch.setattr(engine_mod, "_legacy_cache_warned", False)
     eng, path = _saved_cache(tmp_path)
     rec = json.loads(path.read_text())
     rec.pop("payload_sha256")
     path.write_text(json.dumps(rec))
     fresh = fake_scenario(3, additive([0.1, 0.25, 0.65]))._charac_engine
-    fresh.load_cache(path)
+    # loads — but with a one-time deprecation warning: corruption in a
+    # checksum-less cache is undetectable
+    with pytest.warns(DeprecationWarning, match="UNVERIFIED"):
+        fresh.load_cache(path)
     assert fresh.charac_fct_values == eng.charac_fct_values
+    assert fresh._cache_needs_upgrade
+    # one-time: a second legacy load in the same process stays silent
+    fresh2 = fake_scenario(3, additive([0.1, 0.25, 0.65]))._charac_engine
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        fresh2.load_cache(path)
     # but a legacy-shaped file missing payload keys is still integrity-bad
     path.write_text(json.dumps({"fingerprint": rec["fingerprint"]}))
     with pytest.raises(CacheIntegrityError, match="missing keys"):
         fresh.load_cache(path)
+
+
+def test_legacy_cache_upgrade_round_trip(tmp_path, monkeypatch):
+    """The convergence satellite, end-to-end: a legacy (no-checksum)
+    cache loads with a deprecation warning, the next autosave rewrites it
+    in the checksummed format — even when the resumed sweep is fully
+    memoized and no batch ever fires an autosave — and the rewritten file
+    reloads silently and verified."""
+    import mplc_tpu.contrib.engine as engine_mod
+    from helpers import build_scenario
+
+    def sc():
+        return build_scenario(partners_count=3, dataset_name="titanic",
+                              epoch_count=2,
+                              gradient_updates_per_pass_count=2, seed=9)
+
+    subs = powerset_order(3)
+    eng = CharacteristicEngine(sc())
+    ref = eng.evaluate(subs)
+    path = tmp_path / "cache.json"
+    eng.save_cache(path)
+    rec = json.loads(path.read_text())
+    rec.pop("payload_sha256")
+    path.write_text(json.dumps(rec))
+
+    monkeypatch.setattr(engine_mod, "_legacy_cache_warned", False)
+    fresh = CharacteristicEngine(sc())
+    with pytest.warns(DeprecationWarning):
+        fresh.load_cache(path)
+    fresh.autosave_path = path
+    # a fully-cached sweep: every subset memo-hits, no batch runs (so no
+    # per-batch autosave fires) — the upgrade still happens at the
+    # evaluate() boundary
+    vals = fresh.evaluate(subs)
+    np.testing.assert_array_equal(vals, ref)
+    assert fresh._batch_ordinal == 0
+    upgraded = json.loads(path.read_text())
+    assert "payload_sha256" in upgraded
+    assert not fresh._cache_needs_upgrade
+    # the obligation is to the LOADED file: with the autosave pointed at
+    # a different path, the legacy file itself is still the one upgraded
+    rec2 = dict(upgraded)
+    rec2.pop("payload_sha256")
+    path.write_text(json.dumps(rec2))
+    other = CharacteristicEngine(sc())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # one-time warning already spent
+        other.load_cache(path)
+    elsewhere = tmp_path / "autosave_elsewhere.json"
+    other.autosave_path = elsewhere
+    other.evaluate(subs)
+    assert "payload_sha256" in json.loads(path.read_text())
+    assert not other._cache_needs_upgrade
+    # the upgraded file round-trips verified and silent
+    monkeypatch.setattr(engine_mod, "_legacy_cache_warned", False)
+    final = CharacteristicEngine(sc())
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        final.load_cache(path)
+    assert final.charac_fct_values == eng.charac_fct_values
 
 
 def test_save_cache_fsyncs_before_replace(tmp_path, monkeypatch):
@@ -436,3 +544,58 @@ def test_save_cache_fsyncs_before_replace(tmp_path, monkeypatch):
     assert events.index("fsync") < events.index("replace")
     # and the written file round-trips
     fake_scenario(3, additive([0.1, 0.25, 0.65]))._charac_engine.load_cache(path)
+
+
+# -- 2-D ladder exhaustion (the classified degrade dead end) -----------------
+
+def test_2d_ladder_exhaustion_raises_classified_error(monkeypatch):
+    """When cap-halvings run out in the 2-D partner-sharded mode (which
+    has no CPU rung), the engine raises a classified, actionable
+    `LadderExhaustedError` — never a raw XlaRuntimeError — and the
+    exhaustion is recorded in the resilience report row."""
+    def scenario_2d():
+        from helpers import build_scenario
+        return build_scenario(partners_count=4,
+                              amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
+                              dataset_name="titanic", epoch_count=2,
+                              gradient_updates_per_pass_count=2, seed=9)
+
+    monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "2")
+    monkeypatch.setenv("MPLC_TPU_MAX_CAP_HALVINGS", "1")
+    # singles path: every rung (batch 1 and its recursion's batch 2) OOMs
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "oom@batch1,oom@batch2")
+    eng = CharacteristicEngine(scenario_2d())
+    with trace.collect() as recs:
+        with pytest.raises(faults.LadderExhaustedError) as ei:
+            eng.evaluate([(i,) for i in range(4)])
+    err = ei.value
+    assert err.mode == "2d" and err.halvings == 2
+    # actionable: the message names the remedies and the root cause
+    assert "MPLC_TPU_PARTNER_SHARDS" in str(err)
+    assert "RESOURCE_EXHAUSTED" in str(err)
+    # classified permanent: neither retried nor re-laddered
+    assert not faults.is_transient(err) and not faults.is_oom(err)
+    rep = report.sweep_report(recs)
+    assert rep["resilience"]["ladder_exhausted"] == 1
+    # exhaustion is NOT a rung: the two real halvings stay separate
+    assert rep["resilience"]["cap_halvings"] == 2
+    assert "ladder_exhausted=1" in report.format_report(rep)
+
+
+def test_2d_multis_ladder_exhaustion_is_classified_too(monkeypatch):
+    """The multi-coalition 2-D dispatch path's dead end is classified the
+    same way (it used to re-raise the raw injected OOM)."""
+    def scenario_2d():
+        from helpers import build_scenario
+        return build_scenario(partners_count=4,
+                              amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
+                              dataset_name="titanic", epoch_count=2,
+                              gradient_updates_per_pass_count=2, seed=9)
+
+    monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "2")
+    monkeypatch.setenv("MPLC_TPU_MAX_CAP_HALVINGS", "1")
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "oom@batch1,oom@batch2")
+    eng = CharacteristicEngine(scenario_2d())
+    with pytest.raises(faults.LadderExhaustedError) as ei:
+        eng.evaluate([(0, 1), (0, 2), (1, 2), (0, 1, 2)])
+    assert ei.value.__cause__ is not None  # chained from the device OOM

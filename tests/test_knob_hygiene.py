@@ -56,6 +56,23 @@ def test_every_knob_in_source_is_registered():
         "obligations are checked")
 
 
+def test_static_scan_covers_the_service_package():
+    """The knob scan and the donation lint both walk `mplc_tpu/` by
+    rglob, so the service subpackage (mplc_tpu/service/) must be inside
+    that walk — a knob read (or an undeclared jit) added there has to
+    fail these checks, not hide in an unscanned directory."""
+    service_dir = REPO / "mplc_tpu" / "service"
+    assert service_dir.is_dir()
+    scanned = set(sorted((REPO / "mplc_tpu").rglob("*.py")))
+    svc_files = set(service_dir.glob("*.py"))
+    assert svc_files and svc_files <= scanned
+    # and the service's own knobs are registered with the workload class
+    # (their values reshape the multi-tenant bench workload)
+    for knob in ("MPLC_TPU_SERVICE_FAULT_PLAN",
+                 "MPLC_TPU_SERVICE_MAX_PENDING", "MPLC_TPU_SERVICE_SLICE"):
+        assert constants.ENV_KNOBS.get(knob) == "workload", knob
+
+
 def test_registry_has_no_stale_entries():
     stale = set(constants.ENV_KNOBS) - _knobs_in_sources()
     assert not stale, (

@@ -222,7 +222,7 @@ def test_report_format_and_write(tmp_path):
     assert rep["resilience"] == {
         "retries": 0, "backoff_s": 0.0, "cap_halvings": 0,
         "cpu_degraded": False, "cpu_batches": 0, "cpu_coalitions": 0,
-        "faults_injected": 0}
+        "ladder_exhausted": 0, "faults_injected": 0}
     text = report.format_report(rep)
     assert "hit_rate=75.0%" in text
     assert "pad_waste=25.0%" in text

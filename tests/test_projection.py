@@ -242,3 +242,29 @@ def test_telemetry_trust_row_loads_and_degrades(tmp_path):
     old.write_text(json.dumps({
         "metric": "m", "report": {"wallclock": {"evaluate_s": 290.0}}}))
     assert proj.load_telemetry_trust(str(old)) == {}
+
+
+def test_telemetry_service_row_loads_and_degrades(tmp_path):
+    """load_telemetry_service reads the multi-tenant service row from a
+    BENCH_CONFIG=6 sidecar; single-tenant and pre-service-schema sidecars
+    load as {} — same compat contract as the other rows."""
+    import json
+    new = tmp_path / "telemetry_config6.json"
+    new.write_text(json.dumps({
+        "metric": "m",
+        "report": {"wallclock": {"evaluate_s": 1.0},
+                   "service": {"jobs": 2, "completed": 2,
+                               "quarantined": 0, "cancelled": 0,
+                               "recovered": 0,
+                               "cross_tenant_packed_batches": 3,
+                               "per_tenant": {
+                                   "a": {"seconds": 0.6, "cost_share": 0.6},
+                                   "b": {"seconds": 0.4,
+                                         "cost_share": 0.4}}}}}))
+    svc = proj.load_telemetry_service(str(new))
+    assert svc["jobs"] == 2
+    assert svc["cross_tenant_packed_batches"] == 3
+    old = tmp_path / "telemetry_old.json"
+    old.write_text(json.dumps({
+        "metric": "m", "report": {"wallclock": {"evaluate_s": 290.0}}}))
+    assert proj.load_telemetry_service(str(old)) == {}

@@ -1,0 +1,563 @@
+"""The sweep service's fault matrix (mplc_tpu/service/).
+
+Governing invariants, asserted throughout:
+
+  - ISOLATION: faults attributable to tenant A's job (injected crash,
+    OOM, transient, stall) never abort tenant B's job or perturb its
+    values — B's v(S) table is BIT-IDENTICAL to a solo run of the same
+    scenario on a private engine.
+  - RECOVERY: a killed service restarts on its journal, quarantines a
+    torn tail record, and completes every in-flight sweep bit-identically
+    to an uninterrupted run.
+  - PACKING: a two-tenant run of the same game shape compiles no more
+    slot programs than the larger tenant alone would (program-bank hits
+    asserted) and counts cross-tenant packed batches.
+"""
+
+import json
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from mplc_tpu import faults
+from mplc_tpu.contrib.engine import CharacteristicEngine
+from mplc_tpu.contrib.shapley import powerset_order
+from mplc_tpu.obs import metrics, report, trace
+from mplc_tpu.service import (JobCancelled, JobQuarantined,
+                              JournalCorruptError, ServiceOverloaded,
+                              ServiceRejected, SweepJob, SweepJournal,
+                              SweepService)
+
+P = 3
+SUBSETS = powerset_order(P)
+
+_SERVICE_KNOBS = ("MPLC_TPU_SERVICE_FAULT_PLAN",
+                  "MPLC_TPU_SERVICE_MAX_PENDING", "MPLC_TPU_SERVICE_SLICE",
+                  "MPLC_TPU_FAULT_PLAN", "MPLC_TPU_MAX_RETRIES",
+                  "MPLC_TPU_MAX_CAP_HALVINGS", "MPLC_TPU_SEED_ENSEMBLE",
+                  "MPLC_TPU_PARTNER_FAULT_PLAN")
+
+
+@pytest.fixture(autouse=True)
+def _service_env(monkeypatch):
+    for k in _SERVICE_KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def scenario(seed):
+    from helpers import build_scenario
+    return build_scenario(partners_count=P, dataset_name="titanic",
+                          epoch_count=2, gradient_updates_per_pass_count=2,
+                          seed=seed)
+
+
+_REF = {}
+
+
+def solo_values(seed):
+    """Fault-free solo-engine v(S) for `scenario(seed)`, cached per
+    process (the autouse fixture guarantees a clean env here)."""
+    assert "MPLC_TPU_SERVICE_FAULT_PLAN" not in os.environ
+    if seed not in _REF:
+        _REF[seed] = CharacteristicEngine(scenario(seed)).evaluate(SUBSETS)
+    return _REF[seed]
+
+
+def values_of(job):
+    return np.array([job.engine.charac_fct_values[s] for s in SUBSETS])
+
+
+def _counter(name):
+    return metrics.snapshot()["counters"].get(name, 0)
+
+
+# -- service fault-plan grammar ---------------------------------------------
+
+def test_service_plan_grammar():
+    plan = faults.parse_service_fault_plan(
+        "crash@job2:batch3, oom@job2:batch3,transient@job1:batch1,"
+        "reject@job4,stall@job1:sec2.5")
+    assert plan[2]["batch"] == {("dispatch", 3): ["crash", "oom"]}
+    assert plan[1]["batch"] == {("dispatch", 1): ["transient"]}
+    assert plan[1]["stall_sec"] == 2.5
+    assert plan[4]["reject"] is True
+    assert faults.parse_service_fault_plan(None) == {}
+    assert faults.parse_service_fault_plan("") == {}
+
+
+def test_service_plan_malformed_entries_warn_and_skip():
+    with pytest.warns(UserWarning, match="malformed"):
+        plan = faults.parse_service_fault_plan(
+            "crash@job2,stall@job1,bogus@job3:batch1,crash@job1:batch2")
+    assert list(plan) == [1]
+    assert plan[1]["batch"] == {("dispatch", 2): ["crash"]}
+    with pytest.warns(UserWarning, match="1-based"):
+        assert faults.parse_service_fault_plan("crash@job0:batch1") == {}
+
+
+# -- the happy path: multi-tenant bit-identity + packing ---------------------
+
+def test_two_tenants_bit_identical_to_solo_and_packed(monkeypatch):
+    """The acceptance pair: both tenants' values bit-identical to solo
+    runs, cross-tenant packing observed (> 0 packed batches), and the
+    service compiles no more slot programs than one tenant alone would
+    (the second tenant's buckets are bank hits)."""
+    ref_a, ref_b = solo_values(9), solo_values(11)
+    hits0 = _counter("bank.hits")
+    with trace.collect() as recs:
+        svc = SweepService(start=False, slice_coalitions=3)
+        ja = svc.submit(scenario(9), tenant="A")
+        jb = svc.submit(scenario(11), tenant="B")
+        svc.run_until_idle()
+    assert ja.status == jb.status == "completed"
+    np.testing.assert_array_equal(values_of(ja), ref_a)
+    np.testing.assert_array_equal(values_of(jb), ref_b)
+    # packing is real and observed
+    assert _counter("service.cross_tenant_packed_batches") > 0
+    # ... and cheap: the service region compiled exactly one tenant's
+    # program set (singles + the merged slot bucket), not two
+    one_tenant_programs = len(
+        CharacteristicEngine(scenario(9)).sweep_plan(SUBSETS))
+    bank_compiles = [r for r in recs if r["name"] == "bank.compile"]
+    assert len(bank_compiles) <= one_tenant_programs
+    assert _counter("bank.hits") > hits0
+    # the sweep report carries the service row with fair-share cost
+    rep = report.sweep_report(recs)
+    svc_row = rep["service"]
+    assert svc_row["jobs"] == 2 and svc_row["completed"] == 2
+    assert svc_row["cross_tenant_packed_batches"] > 0
+    shares = [t["cost_share"] for t in svc_row["per_tenant"].values()]
+    assert len(shares) == 2 and abs(sum(shares) - 1.0) < 1e-9
+    text = report.format_report(rep)
+    assert "service     jobs=2" in text and "tenant[A]" in text
+
+
+def test_exact_shapley_scores_match_solo_table():
+    from mplc_tpu.contrib.shapley import shapley_from_characteristic
+
+    svc = SweepService(start=False)
+    job = svc.submit(scenario(9), tenant="A")
+    svc.run_until_idle()
+    vals = {(): 0.0}
+    vals.update({s: v for s, v in zip(SUBSETS, solo_values(9))})
+    np.testing.assert_array_equal(
+        job.result(1.0), shapley_from_characteristic(P, vals))
+
+
+def test_stream_yields_every_value_incrementally():
+    svc = SweepService(start=False, slice_coalitions=2)
+    job = svc.submit(scenario(9), tenant="A")
+    svc.run_until_idle()
+    got = dict(job.stream(timeout=5))
+    assert set(got) == set(SUBSETS)
+    np.testing.assert_array_equal(
+        np.array([got[s] for s in SUBSETS]), solo_values(9))
+
+
+def test_threaded_service_completes_and_drains():
+    svc = SweepService(start=True, slice_coalitions=4)
+    ja = svc.submit(scenario(9), tenant="A")
+    jb = svc.submit(scenario(11), tenant="B")
+    np.testing.assert_array_equal(
+        ja.result(timeout=300), ja.result(timeout=1))
+    jb.result(timeout=300)
+    svc.shutdown(drain=True, timeout=60)
+    np.testing.assert_array_equal(values_of(ja), solo_values(9))
+    np.testing.assert_array_equal(values_of(jb), solo_values(11))
+    with pytest.raises(Exception, match="shut down"):
+        svc.submit(scenario(9))
+
+
+def test_estimator_method_job_matches_solo_run():
+    """Non-exact methods run through the same isolation boundary; the
+    scores are bit-identical to a solo Contributivity run."""
+    from mplc_tpu.contrib.contributivity import Contributivity
+
+    sc = scenario(9)
+    solo = Contributivity(sc)
+    solo.compute_contributivity("Independent scores")
+    svc = SweepService(start=False)
+    job = svc.submit(scenario(9), method="Independent scores", tenant="A")
+    svc.run_until_idle()
+    np.testing.assert_array_equal(
+        job.result(1.0), np.asarray(solo.contributivity_scores))
+
+
+# -- per-tenant fault isolation ----------------------------------------------
+
+@pytest.mark.parametrize("entry", [
+    "crash@job1:batch2",
+    "oom@job1:batch2",
+    "transient@job1:batch2",
+    "stall@job1:sec0.2",
+])
+def test_tenant_a_fault_never_perturbs_tenant_b(monkeypatch, entry):
+    """The isolation matrix: tenant A absorbs a crash / OOM / transient /
+    stall and BOTH tenants still complete with values bit-identical to
+    their solo runs (A recovers via the per-job retry or its engine's
+    private ladder; B never notices)."""
+    ref_a, ref_b = solo_values(9), solo_values(11)
+    monkeypatch.setenv("MPLC_TPU_SERVICE_FAULT_PLAN", entry)
+    svc = SweepService(start=False, slice_coalitions=3)
+    ja = svc.submit(scenario(9), tenant="A")
+    jb = svc.submit(scenario(11), tenant="B")
+    svc.run_until_idle()
+    assert jb.status == "completed"
+    np.testing.assert_array_equal(values_of(jb), ref_b)
+    assert ja.status == "completed"
+    np.testing.assert_array_equal(values_of(ja), ref_a)
+    if entry.startswith("crash"):
+        assert ja.attempts == 1  # one failed attempt, then recovery
+    if entry.startswith("oom"):
+        # the OOM rode A's PRIVATE degrade ladder; B's engine never
+        # stepped down a rung
+        assert ja.engine._cap_halvings == 1
+        assert jb.engine._cap_halvings == 0
+
+
+def test_poison_job_quarantined_after_retry_budget(monkeypatch):
+    """A job that crashes on every attempt is quarantined after
+    MPLC_TPU_MAX_RETRIES instead of retrying forever; the other tenant
+    completes bit-identically."""
+    ref_b = solo_values(11)
+    monkeypatch.setenv("MPLC_TPU_MAX_RETRIES", "1")
+    monkeypatch.setenv("MPLC_TPU_SERVICE_FAULT_PLAN",
+                       "crash@job1:batch1,crash@job1:batch2")
+    svc = SweepService(start=False, slice_coalitions=3)
+    ja = svc.submit(scenario(9), tenant="A")
+    jb = svc.submit(scenario(11), tenant="B")
+    svc.run_until_idle()
+    assert ja.status == "quarantined"
+    assert ja.engine is None  # device buffers released
+    with pytest.raises(JobQuarantined, match="retry budget"):
+        ja.result(1.0)
+    assert _counter("service.jobs_quarantined") == 1
+    assert jb.status == "completed"
+    np.testing.assert_array_equal(values_of(jb), ref_b)
+
+
+def test_permanent_failure_quarantines_without_retry(monkeypatch):
+    """A classified-permanent error (here: a genuine bug in the job's
+    scenario surface, surfacing at engine construction) must not burn
+    retry attempts — poison quarantines on the first attempt."""
+    svc = SweepService(start=False)
+    sc = scenario(9)
+    sc.multi_partner_learning_approach_key = "bogus-approach"
+    job = svc.submit(sc, tenant="A")
+    svc.run_until_idle()
+    assert job.status == "quarantined"
+    assert job.attempts == 1
+    with pytest.raises(JobQuarantined, match="permanent failure"):
+        job.result(1.0)
+
+
+def test_unknown_method_is_a_clean_submit_error():
+    svc = SweepService(start=False)
+    with pytest.raises(ValueError, match="unknown contributivity method"):
+        svc.submit(scenario(9), method="no-such-method", tenant="A")
+
+
+# -- admission control / deadlines -------------------------------------------
+
+def test_backpressure_rejects_with_clean_error(monkeypatch):
+    svc = SweepService(start=False, max_pending=1)
+    svc.submit(scenario(9), tenant="A")
+    with pytest.raises(ServiceOverloaded, match="MPLC_TPU_SERVICE_MAX_PENDING"):
+        svc.submit(scenario(11), tenant="B")
+    assert _counter("service.jobs_rejected") == 1
+    assert _counter("service.jobs_accepted") == 1
+
+
+def test_fault_plan_reject_refuses_admission(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_SERVICE_FAULT_PLAN", "reject@job1")
+    svc = SweepService(start=False)
+    with pytest.raises(ServiceRejected):
+        svc.submit(scenario(9), tenant="A")
+    # the NEXT submission (ordinal 2) is admitted normally
+    job = svc.submit(scenario(11), tenant="B")
+    svc.run_until_idle()
+    assert job.status == "completed"
+
+
+def test_deadline_expiry_cancels_between_batches():
+    """A job whose deadline expires mid-sweep is cancelled cooperatively
+    at a batch boundary — no exception escapes the scheduler, harvested
+    values are preserved, the engine (and its device buffers) is
+    dropped, and later jobs run unaffected."""
+    svc = SweepService(start=False, slice_coalitions=2)
+    job = svc.submit(scenario(9), tenant="A", deadline_sec=1000.0)
+    svc.step()  # partial progress under a live deadline
+    harvested = len(job._stream)
+    assert harvested > 0
+    job.submitted_at -= 10_000  # expire the deadline mid-run
+    svc.run_until_idle()
+    assert job.status == "cancelled"
+    assert job.engine is None
+    assert len(job._stream) >= harvested  # nothing harvested was lost
+    with pytest.raises(JobCancelled, match="deadline"):
+        job.result(1.0)
+    assert _counter("service.jobs_cancelled") == 1
+    # the service keeps serving
+    jb = svc.submit(scenario(11), tenant="B")
+    svc.run_until_idle()
+    assert jb.status == "completed"
+    np.testing.assert_array_equal(values_of(jb), solo_values(11))
+
+
+def test_deadline_cancels_cooperatively_at_batch_boundary(monkeypatch):
+    """The cooperative path specifically: the deadline trips INSIDE a
+    slice, at the engine's per-batch progress hook — the raise lands
+    between batches, the in-flight drain completes (no double-raise),
+    and everything harvested before the trip is preserved."""
+    svc = SweepService(start=False, slice_coalitions=len(SUBSETS))
+    job = svc.submit(scenario(9), tenant="A", deadline_sec=10_000.0)
+    calls = {"n": 0}
+    real = SweepJob._deadline_expired
+
+    def fake(self):
+        if self is not job:
+            return real(self)
+        calls["n"] += 1
+        return calls["n"] > 1  # quantum-start check passes; batch 1 trips
+
+    monkeypatch.setattr(SweepJob, "_deadline_expired", fake)
+    svc.run_until_idle()
+    assert job.status == "cancelled"
+    assert job.engine is None
+    assert job._stream  # the pre-cancel batch's harvest was kept
+    with pytest.raises(JobCancelled, match="batch boundary"):
+        job.result(1.0)
+
+
+def test_deadline_already_expired_cancels_before_any_work():
+    svc = SweepService(start=False)
+    job = svc.submit(scenario(9), tenant="A", deadline_sec=0.0)
+    time.sleep(0.01)
+    svc.run_until_idle()
+    assert job.status == "cancelled"
+    assert job.engine is None
+
+
+# -- journal + crash recovery ------------------------------------------------
+
+def test_journal_append_replay_round_trip(tmp_path):
+    path = tmp_path / "wal.jsonl"
+    j = SweepJournal(path)
+    recs = [{"type": "submit", "job": "a", "tenant": "t"},
+            {"type": "value", "job": "a", "subset": [0, 2],
+             "value": 0.123456789012345}]
+    for r in recs:
+        j.append(r)
+    j.close()
+    replayed, torn = SweepJournal.replay(path)
+    assert replayed == recs and torn is False
+    # float round-trips exactly
+    assert replayed[1]["value"] == recs[1]["value"]
+    assert SweepJournal.replay(tmp_path / "absent.jsonl") == ([], False)
+
+
+def test_journal_torn_tail_quarantined_and_truncated(tmp_path):
+    path = tmp_path / "wal.jsonl"
+    j = SweepJournal(path)
+    j.append({"type": "submit", "job": "a"})
+    j.append({"type": "value", "job": "a", "subset": [0], "value": 0.5})
+    j.close()
+    good = path.read_bytes()
+    path.write_bytes(good + b'{"sha256": "x", "rec": {"type": "val')
+    with pytest.warns(UserWarning, match="torn"):
+        replayed, torn = SweepJournal.replay(path)
+    assert torn is True and len(replayed) == 2
+    assert path.read_bytes() == good  # truncated back to the last record
+    assert (tmp_path / "wal.jsonl.torn").exists()
+    assert _counter("service.journal_torn_records") == 1
+    # idempotent: a second replay of the repaired file is clean
+    assert SweepJournal.replay(path) == (replayed, False)
+
+
+def test_journal_checksum_mismatch_tail_is_torn(tmp_path):
+    path = tmp_path / "wal.jsonl"
+    j = SweepJournal(path)
+    j.append({"type": "submit", "job": "a"})
+    j.append({"type": "value", "job": "a", "subset": [0], "value": 0.5})
+    j.close()
+    lines = path.read_bytes().splitlines()
+    doc = json.loads(lines[1])
+    doc["rec"]["value"] = 0.75  # bit-flip the payload, keep the checksum
+    path.write_bytes(lines[0] + b"\n" + json.dumps(doc).encode() + b"\n")
+    with pytest.warns(UserWarning, match="checksum"):
+        replayed, torn = SweepJournal.replay(path)
+    assert torn is True and replayed == [{"type": "submit", "job": "a"}]
+
+
+def test_journal_mid_file_corruption_raises(tmp_path):
+    path = tmp_path / "wal.jsonl"
+    j = SweepJournal(path)
+    j.append({"type": "submit", "job": "a"})
+    j.append({"type": "value", "job": "a", "subset": [0], "value": 0.5})
+    j.close()
+    lines = path.read_bytes().splitlines()
+    path.write_bytes(b"garbage\n" + lines[1] + b"\n")
+    with pytest.raises(JournalCorruptError, match="not a torn tail"):
+        SweepJournal.replay(path)
+
+
+def test_kill_and_restart_replays_journal_bit_identically(tmp_path):
+    """The acceptance crash-recovery invariant, end-to-end: kill a
+    two-tenant service mid-sweep (with a torn tail record from the kill
+    landing mid-append), restart on the same journal, resubmit, and
+    every sweep completes bit-identically to an uninterrupted run — the
+    recovered jobs train only what was never journaled."""
+    ref_a, ref_b = solo_values(9), solo_values(11)
+    path = tmp_path / "service_wal.jsonl"
+    svc1 = SweepService(journal_path=path, start=False, slice_coalitions=2)
+    svc1.submit(scenario(9), tenant="A", job_id="gameA")
+    svc1.submit(scenario(11), tenant="B", job_id="gameB")
+    svc1.step()
+    svc1.step()
+    svc1.step()  # partial progress on both tenants, then the "kill":
+    # the service object is abandoned with the journal mid-flight, the
+    # kill landing mid-append (a torn final record)
+    with open(path, "ab") as f:
+        f.write(b'{"sha256": "dead", "rec": {"type": "value", "job"')
+
+    with pytest.warns(UserWarning, match="torn"):
+        svc2 = SweepService(journal_path=path, start=False,
+                            slice_coalitions=2)
+    rec = {r["job_id"]: r for r in svc2.recovered_jobs()}
+    assert set(rec) == {"gameA", "gameB"}
+    assert not rec["gameA"]["done"] and rec["gameA"]["values"] > 0
+    ra = svc2.submit(scenario(9), tenant="A", job_id="gameA")
+    rb = svc2.submit(scenario(11), tenant="B", job_id="gameB")
+    svc2.run_until_idle()
+    assert ra.status == rb.status == "completed"
+    np.testing.assert_array_equal(values_of(ra), ref_a)
+    np.testing.assert_array_equal(values_of(rb), ref_b)
+    assert ra.recovered_values > 0
+    assert _counter("service.jobs_recovered") >= 1
+    # the recovered engines trained ONLY the never-journaled coalitions
+    assert ra.engine._batch_ordinal < len(SUBSETS)
+    svc2.shutdown()
+
+    # a THIRD restart finds both jobs done: resubmission completes from
+    # the journal alone, zero batches trained
+    svc3 = SweepService(journal_path=path, start=False)
+    rec3 = {r["job_id"]: r for r in svc3.recovered_jobs()}
+    assert rec3["gameA"]["done"] and rec3["gameB"]["done"]
+    fa = svc3.submit(scenario(9), tenant="A", job_id="gameA")
+    svc3.run_until_idle()
+    assert fa.status == "completed"
+    assert fa.engine._batch_ordinal == 0
+    np.testing.assert_array_equal(values_of(fa), ref_a)
+    svc3.shutdown()
+
+
+def test_restart_with_tenant_a_faults_still_isolates(tmp_path, monkeypatch):
+    """Crash injection + journal recovery compose: tenant A crashes
+    post-restart and both tenants still land bit-identical."""
+    ref_a, ref_b = solo_values(9), solo_values(11)
+    path = tmp_path / "wal.jsonl"
+    svc1 = SweepService(journal_path=path, start=False, slice_coalitions=2)
+    svc1.submit(scenario(9), tenant="A", job_id="gameA")
+    svc1.submit(scenario(11), tenant="B", job_id="gameB")
+    svc1.step()
+    svc1.step()  # kill
+    monkeypatch.setenv("MPLC_TPU_SERVICE_FAULT_PLAN", "crash@job1:batch1")
+    svc2 = SweepService(journal_path=path, start=False, slice_coalitions=2)
+    ra = svc2.submit(scenario(9), tenant="A", job_id="gameA")
+    rb = svc2.submit(scenario(11), tenant="B", job_id="gameB")
+    svc2.run_until_idle()
+    assert ra.status == rb.status == "completed"
+    assert ra.attempts == 1  # the injected crash cost one attempt
+    np.testing.assert_array_equal(values_of(ra), ref_a)
+    np.testing.assert_array_equal(values_of(rb), ref_b)
+    svc2.shutdown()
+
+
+def test_resubmitting_a_different_game_under_a_recovered_id_quarantines(
+        tmp_path):
+    """The journaled submission is the authority on which game a job_id
+    names: resubmitting a DIFFERENT-shaped scenario under a recovered id
+    must refuse to seed (and quarantine), never silently mix two games'
+    v(S) tables."""
+    from helpers import build_scenario
+
+    path = tmp_path / "wal.jsonl"
+    svc1 = SweepService(journal_path=path, start=False, slice_coalitions=2)
+    svc1.submit(scenario(9), tenant="A", job_id="gameA")
+    svc1.step()  # journal some 3-partner values, then "kill"
+    svc2 = SweepService(journal_path=path, start=False)
+    wrong = build_scenario(partners_count=4,
+                           amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
+                           dataset_name="titanic", epoch_count=2,
+                           gradient_updates_per_pass_count=2, seed=9)
+    job = svc2.submit(wrong, tenant="A", job_id="gameA")
+    svc2.run_until_idle()
+    assert job.status == "quarantined"
+    with pytest.raises(JobQuarantined, match="different game"):
+        job.result(1.0)
+    svc2.shutdown()
+
+
+def test_completed_job_releases_device_state_but_keeps_values():
+    """A long-lived service must not retain one game's device arrays per
+    completed job: completion stashes the host-side v(S) table on the
+    handle and drops the engine's stacked/eval data and pipelines."""
+    svc = SweepService(start=False)
+    job = svc.submit(scenario(9), tenant="A")
+    svc.run_until_idle()
+    assert job.status == "completed"
+    assert job.engine.stacked is None and job.engine.val is None
+    assert job.engine.multi_pipe is None and job.engine.program_bank is None
+    # the handle keeps the full table (and the engine its memo/counters)
+    np.testing.assert_array_equal(
+        np.array([job.values[s] for s in SUBSETS]), solo_values(9))
+
+
+def test_journal_write_failure_degrades_instead_of_killing_jobs(
+        tmp_path, monkeypatch):
+    """A WAL append failure on the async path (disk full mid-sweep) must
+    degrade journaling loudly and let jobs finish — never unwind into the
+    scheduler and leave handles blocked forever. The synchronous submit
+    path propagates instead."""
+    from mplc_tpu.service import journal as journal_mod
+
+    path = tmp_path / "wal.jsonl"
+    svc = SweepService(journal_path=path, start=False, slice_coalitions=3)
+    job = svc.submit(scenario(9), tenant="A")
+
+    def boom(self, recs):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(journal_mod.SweepJournal, "append_many", boom)
+    svc.run_until_idle()
+    assert job.status == "completed"
+    assert svc._journal_broken
+    np.testing.assert_array_equal(values_of(job), solo_values(9))
+    # the synchronous path: submit refuses with a clean error and leaves
+    # no phantom job occupying an admission slot
+    with pytest.raises(Exception, match="WAL|journal"):
+        svc.submit(scenario(11), tenant="B", job_id="neverin")
+    assert "neverin" not in svc._jobs
+
+
+def test_quarantine_and_cancel_are_journaled(tmp_path, monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_MAX_RETRIES", "1")
+    monkeypatch.setenv("MPLC_TPU_SERVICE_FAULT_PLAN",
+                       "crash@job1:batch1,crash@job1:batch2")
+    path = tmp_path / "wal.jsonl"
+    svc = SweepService(journal_path=path, start=False)
+    ja = svc.submit(scenario(9), tenant="A", job_id="poison")
+    svc.run_until_idle()
+    assert ja.status == "quarantined"
+    svc.shutdown()
+    svc2 = SweepService(journal_path=path, start=False)
+    rec = {r["job_id"]: r for r in svc2.recovered_jobs()}
+    assert rec["poison"]["quarantined"] is True
