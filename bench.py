@@ -338,9 +338,15 @@ def _spawn_cpu_fallback() -> int:
             # the child writes its own _cpu_fallback-suffixed sidecar;
             # inheriting an explicit path would race the parent's file
             # (and a device-profile dir makes no sense for the CPU
-            # child either)
+            # child either). Same rule for the live-telemetry sidecar
+            # knobs: the child binding the parent's metrics port, or
+            # writing flight/Chrome-trace files over the parent's, would
+            # corrupt the telemetry of the process that spawned it
             "BENCH_TELEMETRY_FILE", "MPLC_TPU_TRACE_FILE",
-            "MPLC_TPU_PROFILE_DIR"):
+            "MPLC_TPU_PROFILE_DIR", "MPLC_TPU_METRICS_PORT",
+            "MPLC_TPU_FLIGHT_RECORDER_DIR",
+            "MPLC_TPU_FLIGHT_RECORDER_SIZE",
+            "MPLC_TPU_CHROME_TRACE_FILE"):
         env.pop(knob, None)
     env.update(
         # A clean PYTHONPATH drops the ambient accelerator registration,

@@ -249,6 +249,34 @@ SERVICE_MAX_PENDING_ENV = "MPLC_TPU_SERVICE_MAX_PENDING"
 SERVICE_SLICE_ENV = "MPLC_TPU_SERVICE_SLICE"
 SERVICE_FAULT_PLAN_ENV = "MPLC_TPU_SERVICE_FAULT_PLAN"
 
+# Live telemetry plane (mplc_tpu/obs/export.py + flight.py + chrome_trace):
+#   MPLC_TPU_METRICS_PORT          when set, one stdlib HTTP daemon thread
+#                                  serves /metrics (Prometheus text),
+#                                  /healthz (liveness + worker heartbeat
+#                                  age + journal status; 503 on stall)
+#                                  and /varz (full JSON state incl.
+#                                  program bank and service job table).
+#                                  A plain port binds LOOPBACK only (the
+#                                  endpoints are unauthenticated);
+#                                  host:port (e.g. 0.0.0.0:9090) opts
+#                                  into wider exposure. 0 = ephemeral
+#                                  port (tests). Unset = NO thread or
+#                                  socket is created.
+#   MPLC_TPU_FLIGHT_RECORDER_DIR   where crash flight-recorder postmortem
+#                                  files land (default: the working dir)
+#   MPLC_TPU_FLIGHT_RECORDER_SIZE  records held in the always-on span
+#                                  ring dumped on quarantine / ladder
+#                                  exhaustion / journal corruption (512)
+#   MPLC_TPU_CHROME_TRACE_FILE     Chrome trace-event JSON written at
+#                                  interpreter exit from the span JSONL
+#                                  (requires MPLC_TPU_TRACE_FILE); the
+#                                  offline equivalent is
+#                                  scripts/trace_to_perfetto.py
+METRICS_PORT_ENV = "MPLC_TPU_METRICS_PORT"
+FLIGHT_RECORDER_DIR_ENV = "MPLC_TPU_FLIGHT_RECORDER_DIR"
+FLIGHT_RECORDER_SIZE_ENV = "MPLC_TPU_FLIGHT_RECORDER_SIZE"
+CHROME_TRACE_ENV = "MPLC_TPU_CHROME_TRACE_FILE"
+
 # ---------------------------------------------------------------------------
 # Env-knob registry. EVERY `MPLC_TPU_*` env var the framework reads must be
 # registered here with its class — tests/test_knob_hygiene.py greps the
@@ -306,5 +334,13 @@ ENV_KNOBS = {
     "MPLC_TPU_SYNTH_SCALE": "workload",
     "MPLC_TPU_PROFILE_DIR": "sidecar",
     "MPLC_TPU_TRACE_FILE": "sidecar",
+    # the live telemetry plane is pure observability plumbing: none of it
+    # changes what a sweep computes or pays for, but all of it must be
+    # stripped from the CPU-fallback child (the child would race the
+    # parent's telemetry port, flight-recorder files and Chrome-trace out)
+    "MPLC_TPU_METRICS_PORT": "sidecar",
+    "MPLC_TPU_FLIGHT_RECORDER_DIR": "sidecar",
+    "MPLC_TPU_FLIGHT_RECORDER_SIZE": "sidecar",
+    "MPLC_TPU_CHROME_TRACE_FILE": "sidecar",
     "MPLC_TPU_DATA_DIR": "ambient",
 }

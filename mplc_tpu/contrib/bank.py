@@ -89,6 +89,28 @@ def reset_bank() -> None:
         _INFLIGHT.clear()
 
 
+def bank_stats() -> dict:
+    """The process-global bank's state for the /varz endpoint
+    (obs/export.py): how many bundles it holds, how many compiles are in
+    flight, and the (stringified) program keys — enough for an operator
+    to see whether a tenant's shape is served from the bank without
+    attaching a debugger."""
+    with _LOCK:
+        keys = [str(k) for k in _PROGRAMS]
+        failed = sum(1 for v in _PROGRAMS.values()
+                     if not isinstance(v, dict))
+        return {
+            "enabled": bank_enabled(),
+            "programs": len(keys),
+            "failed_compiles": failed,
+            "inflight": len(_INFLIGHT),
+            "max_programs": _MAX_PROGRAMS,
+            "manifest_dir": manifest_dir(),
+            # capped: /varz is a snapshot, not a dump
+            "keys": keys[:50],
+        }
+
+
 def manifest_dir() -> "str | None":
     """Where the persistent manifest lives: the configured compile-cache
     dir (env knob first, then whatever the process pointed jax's

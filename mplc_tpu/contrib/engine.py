@@ -895,12 +895,14 @@ class CharacteristicEngine:
                 self.single_pipe.trainer, b)
         return self._singles_pipes[b]
 
-    def _retry_transient(self, op, site: str):
+    def _retry_transient(self, op, site: str, ordinal: "int | None" = None):
         """Run `op` with bounded exponential backoff on transient runtime
         failures (`faults.is_transient`): up to MPLC_TPU_MAX_RETRIES
         retries. The per-coalition rng-fold streams make a re-dispatched
         batch bit-identical to the failed attempt, so a retry can never
-        change v(S). OOM and non-transient errors propagate."""
+        change v(S). OOM and non-transient errors propagate. `ordinal`
+        (the 1-based batch number) rides the engine.retry event so trace
+        tooling can flow-link a retry to the batch it recovered."""
         attempt = 0
         while True:
             try:
@@ -909,7 +911,7 @@ class CharacteristicEngine:
                 if not faults.is_transient(e) or attempt >= self._max_retries:
                     raise
                 attempt += 1
-                self._backoff(site, attempt, e)
+                self._backoff(site, attempt, e, ordinal)
 
     def _fetch_with_retry(self, fetch, meta):
         """Harvest with transient recovery: a failed result fetch
@@ -932,16 +934,18 @@ class CharacteristicEngine:
                         or attempt >= self._max_retries):
                     raise
                 attempt += 1
-                self._backoff("harvest", attempt, e)
+                self._backoff("harvest", attempt, e, meta.get("ordinal"))
                 fetch = None  # re-dispatch on the next attempt
 
-    def _backoff(self, site: str, attempt: int, err: BaseException) -> None:
+    def _backoff(self, site: str, attempt: int, err: BaseException,
+                 ordinal: "int | None" = None) -> None:
         delay = min(self._retry_backoff * (2 ** (attempt - 1)),
                     constants.RETRY_BACKOFF_CAP_SEC)
         obs_metrics.counter("engine.retries").inc()
         obs_metrics.counter("engine.backoff_sec").inc(delay)
         obs_trace.event("engine.retry", site=site, attempt=attempt,
-                        backoff_sec=delay, error=str(err)[:200])
+                        ordinal=ordinal, backoff_sec=delay,
+                        error=str(err)[:200])
         logger.warning(
             "transient %s failure (attempt %d/%d, backing off %.2f s): %s",
             site, attempt, self._max_retries, delay, err)
@@ -991,6 +995,13 @@ class CharacteristicEngine:
         obs_metrics.counter("engine.ladder_exhausted").inc()
         obs_trace.event("engine.degrade", action="ladder_exhausted",
                         halvings=self._cap_halvings, error=str(err)[:200])
+        # a terminal, PERMANENT failure is exactly what the crash flight
+        # recorder exists for: dump the recent-span ring + metrics now,
+        # while the dead batch's dispatch/degrade records are still in it
+        from ..obs import flight as obs_flight
+        postmortem = obs_flight.dump("ladder_exhausted", extra={
+            "halvings": self._cap_halvings,
+            "error": str(err)[:500]})
         return faults.LadderExhaustedError(
             f"device OOM persisted through {self._max_cap_halvings} "
             "cap-halvings and the 2-D partner-sharded mode has no CPU "
@@ -999,8 +1010,11 @@ class CharacteristicEngine:
             "MPLC_TPU_COALITIONS_PER_DEVICE or MPLC_TPU_PARTNER_SHARDS, "
             "shrink MPLC_TPU_EVAL_CHUNK, or "
             "run this scenario on the 1-D path (which degrades to CPU). "
-            f"Last device error: {str(err)[:200]}",
-            halvings=self._cap_halvings, mode="2d")
+            f"Last device error: {str(err)[:200]}"
+            + (f" Postmortem flight record: {postmortem}"
+               if postmortem else ""),
+            halvings=self._cap_halvings, mode="2d",
+            postmortem_path=postmortem)
 
     def _record_or_recover(self, prev, per_partner, slot_count, pipe) -> None:
         """`_record_group` plus the harvest-side OOM ladder: when FETCHING
@@ -1188,7 +1202,8 @@ class CharacteristicEngine:
 
                 meta["redispatch"] = dispatch
                 try:
-                    fetch = self._retry_transient(dispatch, "dispatch")
+                    fetch = self._retry_transient(
+                        dispatch, "dispatch", meta["ordinal"])
                 except Exception as e:
                     if not faults.is_oom(e):
                         raise
@@ -1289,7 +1304,8 @@ class CharacteristicEngine:
                                                  test, self._coalition_rng(()))
 
             meta["redispatch"] = dispatch
-            fetch = self._retry_transient(dispatch, "dispatch")
+            fetch = self._retry_transient(
+                dispatch, "dispatch", meta["ordinal"])
             self._record_group(group, fetch, len(jobs) - i, meta,
                                per_partner, slot_count)
 
@@ -1353,6 +1369,7 @@ class CharacteristicEngine:
         obs_trace.event(
             "engine.batch", dur=time.perf_counter() - meta["t0"],
             width=meta["width"], slot_count=slot_count,
+            ordinal=meta.get("ordinal"),
             coalitions=meta["coalitions"], padding=meta["padding"],
             epochs=batch_epochs, samples=batch_samples,
             partner_passes=batch_passes, **extra)
@@ -1469,7 +1486,8 @@ class CharacteristicEngine:
 
                 meta["redispatch"] = dispatch
                 try:
-                    fetch = self._retry_transient(dispatch, "dispatch")
+                    fetch = self._retry_transient(
+                        dispatch, "dispatch", meta["ordinal"])
                 except Exception as e:
                     if not faults.is_oom(e):
                         raise

@@ -113,12 +113,17 @@ class LadderExhaustedError(RuntimeError):
     service above all — can act on it: it is PERMANENT (re-dispatching at
     the same exhausted cap would OOM identically), so the service
     quarantines only the owning tenant's job instead of retrying
-    forever, and the resilience report row records the exhaustion."""
+    forever, and the resilience report row records the exhaustion.
+    `postmortem_path` names the crash flight-recorder dump
+    (obs/flight.py) written when the ladder died — the recent-span ring
+    plus a metrics snapshot — or None when no dump could be written."""
 
-    def __init__(self, msg: str, *, halvings: int = 0, mode: str = "2d"):
+    def __init__(self, msg: str, *, halvings: int = 0, mode: str = "2d",
+                 postmortem_path: "str | None" = None):
         super().__init__(msg)
         self.halvings = halvings
         self.mode = mode
+        self.postmortem_path = postmortem_path
 
 
 # Real XlaRuntimeError messages lead with a gRPC-style status code. Codes

@@ -1,16 +1,22 @@
 """Observability for the coalition engine: structured tracing (`trace`),
-a process-global metrics registry (`metrics`) and run reports (`report`).
+a process-global metrics registry (`metrics`), run reports (`report`),
+live telemetry endpoints (`export`), Chrome-trace conversion
+(`chrome_trace`) and the crash flight recorder (`flight`).
 
 Zero dependencies beyond the stdlib; everything is host-side and adds no
 device syncs to the instrumented hot paths. Tracing emits JSONL when
-`MPLC_TPU_TRACE_FILE` is set (no-op otherwise); `report.sweep_report`
-turns collected spans into the compile/dispatch/harvest split, memo hit
-rate, padding waste and per-bucket throughput.
+`MPLC_TPU_TRACE_FILE` is set (a bounded in-memory ring for the flight
+recorder is always on); `report.sweep_report` turns collected spans into
+the compile/dispatch/harvest split, memo hit rate, padding waste,
+per-bucket throughput and per-tenant SLO quantiles; `export` serves
+/metrics (Prometheus), /healthz and /varz from a stdlib HTTP thread when
+`MPLC_TPU_METRICS_PORT` is set.
 """
 
-from . import metrics, report, trace
+from . import chrome_trace, export, flight, metrics, report, trace
 from .report import format_report, sweep_report, write_report
 from .trace import collect, event, span, start_span
 
-__all__ = ["metrics", "report", "trace", "span", "start_span", "event",
-           "collect", "sweep_report", "format_report", "write_report"]
+__all__ = ["chrome_trace", "export", "flight", "metrics", "report",
+           "trace", "span", "start_span", "event", "collect",
+           "sweep_report", "format_report", "write_report"]

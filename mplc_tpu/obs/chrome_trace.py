@@ -1,0 +1,212 @@
+"""Chrome trace-event export: span JSONL -> Perfetto-loadable JSON.
+
+The span JSONL sink (`MPLC_TPU_TRACE_FILE`) records the engine's
+compile/dispatch/harvest overlap, but as flat lines it answers nothing
+visually. This module converts it into the Chrome trace-event format
+(the JSON object form: `{"traceEvents": [...]}`) that
+https://ui.perfetto.dev loads directly:
+
+  - every record becomes a complete ("X") slice on a per-thread track
+    (`pid` 1, `tid` = the recording thread id, named via "M" metadata
+    events); zero-duration events are widened to 1 us so they render and
+    can anchor flows;
+  - timestamps are rebased to the trace's first record and expressed in
+    microseconds (the format's unit);
+  - FLOW events (ph "s"/"f") draw arrows linking the recovery machinery
+    to the work it recovered: `engine.retry` / `engine.fault` records
+    (which carry the batch `ordinal`) to the next `engine.batch` of the
+    same ordinal on the same thread, `engine.degrade` records to the
+    next batch on the thread (the re-bucketed dispatch), and
+    `service.job_fault` records to the job's next `service.slice` (the
+    requeue). A retry storm is one glance instead of a grep.
+
+`read_jsonl` tolerates torn lines — the signature of a process killed
+mid-append (the atexit flush in obs/trace.py prevents them on clean
+exits) — counting and reporting them instead of dying on byte 10^7 of a
+10^7+1-byte trace.
+
+CLI wrapper: scripts/trace_to_perfetto.py. Live export: setting
+`MPLC_TPU_CHROME_TRACE_FILE` converts the trace automatically at
+interpreter exit (hook in obs/trace.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+
+CHROME_TRACE_ENV = "MPLC_TPU_CHROME_TRACE_FILE"
+
+# record-name -> flow-arrow label for the recovery links drawn below
+_FLOW_SOURCES = {"engine.retry": "retry", "engine.fault": "fault",
+                 "engine.degrade": "degrade",
+                 "service.job_fault": "requeue"}
+
+
+def read_jsonl(path: str) -> tuple[list, int]:
+    """(records, torn_lines): every parseable record of a span JSONL
+    trace, in file order. Unparseable or schema-less lines (torn tail
+    from a hard kill, truncated flush) are counted, not fatal."""
+    records = []
+    torn = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                if not isinstance(rec, dict) or "name" not in rec:
+                    raise ValueError("not a span record")
+            except ValueError:
+                torn += 1
+                continue
+            records.append(rec)
+    return records, torn
+
+
+def _attrs(rec: dict) -> dict:
+    return rec.get("attrs") or {}
+
+
+def to_chrome(records: list) -> dict:
+    """Chrome trace-event JSON (object form) from span records."""
+    events = []
+    if records:
+        t0 = min(float(r.get("ts") or 0.0) for r in records)
+    else:
+        t0 = 0.0
+
+    tids = []
+    slices = []  # (rec, ts_us, dur_us) in file order, for flow targets
+    for rec in records:
+        tid = int(rec.get("thread") or 0)
+        if tid not in tids:
+            tids.append(tid)
+        ts_us = (float(rec.get("ts") or 0.0) - t0) * 1e6
+        dur_us = max(float(rec.get("dur") or 0.0) * 1e6, 1.0)
+        name = rec.get("name", "?")
+        events.append({
+            "name": name,
+            "cat": name.split(".", 1)[0],
+            "ph": "X",
+            "ts": ts_us,
+            "dur": dur_us,
+            "pid": 1,
+            "tid": tid,
+            "args": {**_attrs(rec), "span_id": rec.get("id"),
+                     "parent_span": rec.get("parent")},
+        })
+        slices.append((rec, ts_us, dur_us))
+
+    # thread tracks: name them, keep file-discovery order stable
+    for i, tid in enumerate(tids):
+        events.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": 1,
+                       "tid": tid, "args": {"name": f"thread-{tid}"}})
+        events.append({"name": "thread_sort_index", "ph": "M", "ts": 0,
+                       "pid": 1, "tid": tid, "args": {"sort_index": i}})
+
+    flows = _flow_events(slices)
+    events.extend(flows)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "mplc_tpu span JSONL",
+                      "records": len(records), "flows": len(flows) // 2},
+    }
+
+
+def _flow_events(slices: list) -> list:
+    """ph "s"/"f" pairs for the recovery links (module docstring). Flow
+    binding rule: the start event sits just inside the source slice, the
+    finish (`bp: "e"`) just inside the target slice — both slices exist
+    because zero-duration records were widened to 1 us.
+
+    Targets are pre-indexed by key so a fault-heavy trace converts in one
+    forward pass (a per-source rescan of all later records is quadratic
+    in record count): "the NEXT matching record after position i" is a
+    `bisect` into that key's position list."""
+    import bisect
+
+    # key -> ([file positions], [slice tuples]), positions ascending
+    batch_by_tid_ord: dict = {}   # (tid, ordinal) — retry/fault targets
+    batch_by_tid: dict = {}       # tid             — degrade targets
+    slice_by_job: dict = {}       # job             — requeue targets
+    for i, entry in enumerate(slices):
+        rec = entry[0]
+        a = _attrs(rec)
+        if rec.get("name") == "engine.batch":
+            tid = int(rec.get("thread") or 0)
+            for key, idx in (((tid, a.get("ordinal")), batch_by_tid_ord),
+                             ((tid,), batch_by_tid)):
+                pos, items = idx.setdefault(key, ([], []))
+                pos.append(i)
+                items.append(entry)
+        elif rec.get("name") == "service.slice":
+            pos, items = slice_by_job.setdefault(a.get("job"), ([], []))
+            pos.append(i)
+            items.append(entry)
+
+    def next_after(index: dict, key, i):
+        hit = index.get(key)
+        if hit is None:
+            return None
+        pos, items = hit
+        j = bisect.bisect_right(pos, i)
+        return items[j] if j < len(items) else None
+
+    out = []
+    flow_id = 0
+    for i, (rec, ts_us, _dur) in enumerate(slices):
+        label = _FLOW_SOURCES.get(rec.get("name"))
+        if label is None:
+            continue
+        a = _attrs(rec)
+        tid = int(rec.get("thread") or 0)
+        if rec.get("name") == "service.job_fault":
+            # the requeue link: this job's next scheduling quantum
+            target = next_after(slice_by_job, a.get("job"), i)
+        elif a.get("ordinal") is not None:
+            # retry/fault carry the batch ordinal
+            target = next_after(batch_by_tid_ord, (tid, a["ordinal"]), i)
+        else:
+            # degrade (an OOM re-bucket) links to whatever batch
+            # dispatches next on the thread
+            target = next_after(batch_by_tid, (tid,), i)
+        if target is None:
+            continue
+        nrec, nts, ndur = target
+        flow_id += 1
+        out.append({"name": label, "cat": "flow", "ph": "s", "id": flow_id,
+                    "ts": ts_us + 0.5, "pid": 1, "tid": tid})
+        out.append({"name": label, "cat": "flow", "ph": "f", "bp": "e",
+                    "id": flow_id, "ts": nts + min(0.5, ndur / 2),
+                    "pid": 1, "tid": int(nrec.get("thread") or 0)})
+    return out
+
+
+def convert(in_path: str, out_path: str | None = None) -> dict:
+    """Read a span JSONL trace, write Chrome trace-event JSON (atomic
+    temp + rename), return a summary dict: {out, records, events, flows,
+    torn_lines}."""
+    records, torn = read_jsonl(in_path)
+    doc = to_chrome(records)
+    if torn:
+        doc["otherData"]["torn_lines"] = torn
+        warnings.warn(
+            f"{in_path}: {torn} unparseable line(s) skipped (torn tail "
+            "from a hard kill, or a non-span line); the converted trace "
+            "covers every intact record", stacklevel=2)
+    if out_path is None:
+        base = in_path[:-6] if in_path.endswith(".jsonl") else in_path
+        out_path = base + ".chrome.json"
+    d = os.path.dirname(os.path.abspath(out_path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{out_path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, out_path)
+    return {"out": out_path, "records": len(records),
+            "events": len(doc["traceEvents"]),
+            "flows": doc["otherData"]["flows"], "torn_lines": torn}

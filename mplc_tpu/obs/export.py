@@ -1,0 +1,315 @@
+"""Live telemetry endpoints: a zero-dependency stdlib HTTP thread serving
+the metrics registry and service health while a sweep runs.
+
+The batch-shaped obs layer (collect() + sweep_report after the run) is
+useless to an operator of a LIVE multi-tenant service: queue depth,
+per-tenant SLO quantiles and a stalled worker must be observable while
+the process is running. This module starts — only when
+`MPLC_TPU_METRICS_PORT` is set — one `ThreadingHTTPServer` daemon thread
+with three routes:
+
+  /metrics   Prometheus text exposition (version 0.0.4) rendered from
+             `metrics.export_view()`: counters, gauges, and real
+             histogram series (`_bucket{le=...}` from the shared log2
+             bounds, `_sum`, `_count`) with labels (e.g. `tenant`)
+             quoted per the format. Names are prefixed `mplc_` and
+             sanitized (dots -> underscores); the bracketed
+             per-executable suffix of `trainer.compiles[<fn>]` becomes
+             an `item` label.
+  /healthz   JSON liveness: 200 when every registered health provider
+             reports healthy, 503 otherwise. The sweep service registers
+             a provider exposing worker liveness, heartbeat age (flips
+             unhealthy when a quantum stalls past
+             `service.scheduler.STALL_HEALTHY_SEC` with a job running),
+             queue depth and journal status.
+  /varz      Full JSON state snapshot: the metrics registry plus every
+             registered varz provider (service job table, program-bank
+             contents when the bank module is loaded).
+
+With the env var UNSET nothing happens: no socket, no thread — the
+instrumented paths cost exactly what they cost before. A plain port
+value binds LOOPBACK only (the endpoints are unauthenticated — tenant
+names, job tables, error strings); `host:port` opts into wider exposure
+explicitly. Port 0 binds an ephemeral port (tests; the bound port is on
+`TelemetryServer.port` and in the start-up log line). The server is a
+process singleton: the first `start()` wins, later calls return it.
+
+Providers are plain callables returning JSON-ready dicts, registered
+under a name (`register_health`/`register_varz`); a provider that raises
+is reported as an error entry, never a 500 — the telemetry plane must
+not be takeable-down by the thing it observes.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import os
+import re
+import threading
+import time
+import warnings
+import weakref
+
+from . import metrics
+
+logger = logging.getLogger("mplc_tpu")
+
+METRICS_PORT_ENV = "MPLC_TPU_METRICS_PORT"
+
+_lock = threading.Lock()
+_server: "TelemetryServer | None" = None
+_health_providers: dict = {}
+_varz_providers: dict = {}
+
+
+# -- provider registry --------------------------------------------------------
+
+def register_health(name: str, fn) -> None:
+    """Register a health provider: `fn()` returns a JSON-ready dict; a
+    `healthy: False` entry flips /healthz to 503. Pass a
+    `weakref.WeakMethod` to auto-unregister when the owning object is
+    collected (how SweepService registers: a dropped, never-shut-down
+    service must not haunt /healthz forever)."""
+    with _lock:
+        _health_providers[name] = fn
+
+
+def register_varz(name: str, fn) -> None:
+    with _lock:
+        _varz_providers[name] = fn
+
+
+def unregister(name: str) -> None:
+    with _lock:
+        _health_providers.pop(name, None)
+        _varz_providers.pop(name, None)
+
+
+def _call_providers(providers: dict) -> dict:
+    out = {}
+    for name, fn in sorted(providers.items()):
+        if isinstance(fn, weakref.WeakMethod):
+            live = fn()
+            if live is None:
+                unregister(name)  # the owner was collected
+                continue
+            fn = live
+        try:
+            out[name] = fn()
+        except Exception as e:  # a broken provider must not 500 the route
+            out[name] = {"healthy": False, "error": str(e)[:500]}
+    return out
+
+
+def health_view() -> tuple[bool, dict]:
+    """(healthy, body) for /healthz: healthy iff every provider is."""
+    with _lock:
+        providers = dict(_health_providers)
+    body = _call_providers(providers)
+    healthy = all(p.get("healthy", True) is not False
+                  for p in body.values() if isinstance(p, dict))
+    return healthy, {"healthy": healthy, "ts": time.time(),
+                     "pid": os.getpid(), "providers": body}
+
+
+def varz_view() -> dict:
+    """Full JSON snapshot for /varz: metrics registry + varz providers +
+    the program bank's contents when its module is already loaded (never
+    force-imports jax into a lean process)."""
+    with _lock:
+        providers = dict(_varz_providers)
+    out = {"ts": time.time(), "pid": os.getpid(),
+           "metrics": metrics.snapshot()}
+    out.update(_call_providers(providers))
+    if "program_bank" not in out:
+        try:
+            import sys
+            bank = sys.modules.get("mplc_tpu.contrib.bank")
+            if bank is not None:
+                out["program_bank"] = bank.bank_stats()
+        except Exception as e:
+            out["program_bank"] = {"error": str(e)[:200]}
+    return out
+
+
+# -- Prometheus rendering -----------------------------------------------------
+
+_BRACKET_RE = re.compile(r"^(?P<base>[^\[\]]+)\[(?P<item>.+)\]$")
+_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_parts(name: str, labels: dict) -> tuple[str, dict]:
+    """(prometheus metric name, labels) for a registry metric name: the
+    `name[item]` per-executable convention becomes an `item` label."""
+    m = _BRACKET_RE.match(name)
+    if m is not None:
+        name = m.group("base")
+        labels = dict(labels, item=m.group("item"))
+    return "mplc_" + _SANITIZE_RE.sub("_", name), labels
+
+
+def _label_str(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{_SANITIZE_RE.sub("_", k)}="{_escape(str(v))}"'
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def prometheus_text() -> str:
+    """The whole registry in Prometheus text exposition format 0.0.4."""
+    lines = []
+    typed: set = set()
+    for row in metrics.export_view():
+        name, labels = _prom_parts(row["name"], row["labels"])
+        kind = row["kind"]
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+        if kind == "histogram":
+            cum = 0
+            for bound, c in zip(row["bounds"], row["bucket_counts"]):
+                cum += c
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_label_str(dict(labels, le=_fmt(bound)))} {cum}")
+            cum += row["bucket_counts"][-1]
+            lines.append(
+                f'{name}_bucket{_label_str(dict(labels, le="+Inf"))} {cum}')
+            lines.append(f"{name}_sum{_label_str(labels)} "
+                         f"{_fmt(row['sum'])}")
+            lines.append(f"{name}_count{_label_str(labels)} {row['count']}")
+        else:
+            value = row["value"]
+            if value is None:
+                continue  # an unset gauge has no sample
+            lines.append(f"{name}{_label_str(labels)} {_fmt(value)}")
+    return "\n".join(lines) + "\n"
+
+
+# -- the HTTP server ----------------------------------------------------------
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = prometheus_text().encode()
+            self._reply(200, body, "text/plain; version=0.0.4")
+        elif path == "/healthz":
+            healthy, view = health_view()
+            self._reply(200 if healthy else 503,
+                        json.dumps(view, default=str).encode(),
+                        "application/json")
+        elif path == "/varz":
+            self._reply(200, json.dumps(varz_view(), default=str).encode(),
+                        "application/json")
+        elif path == "/":
+            self._reply(200, b"mplc_tpu telemetry: /metrics /healthz /varz\n",
+                        "text/plain")
+        else:
+            self._reply(404, b"not found\n", "text/plain")
+
+    def _reply(self, status: int, body: bytes, ctype: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args) -> None:  # silence per-request spam
+        pass
+
+
+class TelemetryServer:
+    """One process-wide HTTP thread serving the routes above."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="mplc-telemetry")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start(port: int, host: str = "127.0.0.1") -> TelemetryServer:
+    """Start (or return) the process-singleton telemetry server. Binds
+    loopback by default — the endpoints are unauthenticated (tenant
+    names, job tables, error strings), so exposing them beyond the host
+    is an explicit operator decision (`MPLC_TPU_METRICS_PORT=host:port`),
+    not a side effect."""
+    global _server
+    with _lock:
+        if _server is None:
+            srv = TelemetryServer(port, host)
+            logger.info("telemetry server listening on %s:%d "
+                        "(/metrics /healthz /varz)", srv.host, srv.port)
+            _server = srv
+        elif port not in (0, _server.port):
+            warnings.warn(
+                f"telemetry server already bound to :{_server.port}; "
+                f"ignoring request for :{port}", stacklevel=2)
+        return _server
+
+
+def stop() -> None:
+    """Shut the singleton down (tests; production lets the daemon die
+    with the process)."""
+    global _server
+    with _lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.close()
+
+
+def active_server() -> "TelemetryServer | None":
+    return _server
+
+
+def maybe_start_from_env() -> "TelemetryServer | None":
+    """Start the server iff `MPLC_TPU_METRICS_PORT` is set. Unset/empty
+    -> None with NO socket or thread created; a malformed value warns and
+    stays off (telemetry must never kill the workload it watches). A
+    plain port binds loopback only; `host:port` (e.g. `0.0.0.0:9090`)
+    opts into wider exposure explicitly."""
+    raw = os.environ.get(METRICS_PORT_ENV)
+    if not raw:
+        return None
+    host, _, port_s = raw.rpartition(":")
+    host = host or "127.0.0.1"
+    try:
+        port = int(port_s)
+        if not 0 <= port <= 65535:
+            raise ValueError(raw)
+    except ValueError:
+        warnings.warn(
+            f"{METRICS_PORT_ENV}={raw!r} is not a port number (0-65535) "
+            "or host:port; telemetry endpoints disabled", stacklevel=2)
+        return None
+    try:
+        return start(port, host)
+    except OSError as e:
+        warnings.warn(
+            f"telemetry server could not bind {host}:{port} ({e}); "
+            "endpoints disabled", stacklevel=2)
+        return None

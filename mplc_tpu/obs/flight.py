@@ -1,0 +1,78 @@
+"""Crash flight recorder: postmortem dumps of the always-on span ring.
+
+An operator debugging a quarantined tenant, an exhausted OOM ladder or a
+corrupt service journal needs the spans of the FAILING work — but the
+failure is precisely the run nobody was tracing on purpose.
+`obs/trace.py` therefore keeps a bounded ring of the most recent
+span/event records unconditionally (`MPLC_TPU_FLIGHT_RECORDER_SIZE`,
+default 512), and this module dumps it — plus a full metrics snapshot —
+to an atomic postmortem JSON file when one of the three terminal
+failures fires:
+
+  - `service.JobQuarantined` (service/scheduler.py `_fail_attempt`),
+  - `faults.LadderExhaustedError` (contrib/engine.py `_ladder_exhausted`),
+  - `service.JournalCorruptError` (service/journal.py `replay`).
+
+The triggering log line references the written file, so the postmortem
+is one `less` away from the quarantine message.
+
+File format (one JSON object):
+
+    {"reason": str, "ts": epoch-s, "pid": int, "extra": {...},
+     "ring_records": [trace records, oldest first],
+     "metrics": metrics.snapshot()}
+
+Files land in `MPLC_TPU_FLIGHT_RECORDER_DIR` (default: the working
+directory) as `mplc_flight_<reason>_<pid>_<seq>.json`; the write is
+temp-file + `os.replace`, same atomicity discipline as the engine's
+cache autosave. `dump()` NEVER raises — a postmortem writer that can
+itself kill the process (disk full during an OOM spiral) is worse than
+no postmortem.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import time
+
+logger = logging.getLogger("mplc_tpu")
+
+FLIGHT_DIR_ENV = "MPLC_TPU_FLIGHT_RECORDER_DIR"
+
+_seq = itertools.count(1)
+
+
+def dump(reason: str, extra: dict | None = None) -> str | None:
+    """Write a postmortem file for `reason`; returns its path, or None
+    when the dump failed (logged, never raised)."""
+    try:
+        from . import metrics, trace
+
+        records = trace.flight_records()
+        payload = {
+            "reason": reason,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "extra": dict(extra or {}),
+            "ring_records": records,
+            "metrics": metrics.snapshot(),
+        }
+        out_dir = os.environ.get(FLIGHT_DIR_ENV) or "."
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(
+            out_dir, f"mplc_flight_{reason}_{os.getpid()}_{next(_seq)}.json")
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, default=str)
+        os.replace(tmp, path)
+        metrics.counter("obs.flight_dumps").inc()
+        trace.event("flight.dump", reason=reason, path=path,
+                    records=len(records))
+        return path
+    except Exception as e:  # noqa: BLE001 — the no-raise contract
+        logger.error("flight recorder: postmortem dump for %r failed: %s",
+                     reason, e)
+        return None

@@ -5,6 +5,21 @@ The sweep telemetry layer (obs/trace.py gives *when*, this module gives
 padding waste, epochs trained, device-memory high water. Everything is
 host-side arithmetic — incrementing a counter never syncs the device.
 
+Metrics may carry LABELS (`counter("service.queue_wait_sec",
+tenant="t0")`): each distinct (name, labels) pair is its own metric
+object, keyed in the registry as `name{k=v,...}` with sorted label keys.
+Labels are how the multi-tenant service exports per-tenant SLO series to
+the `/metrics` endpoint (obs/export.py) without inventing one metric
+name per tenant; unlabeled metrics keep their plain-`name` keys, so
+every pre-label snapshot consumer reads unchanged.
+
+Histograms record count/sum/min/max PLUS fixed log2 bucket counts
+(`LOG_BUCKET_BOUNDS`, ~1e-6 .. 4096 — seconds-and-fractions scale), so
+p50/p95/p99 are derivable at read time (`Histogram.quantile`) and the
+Prometheus exporter can emit real `_bucket{le=...}` series. Bucket
+boundaries are process-wide constants: two histograms are always
+mergeable, and a quantile is at worst one bucket-width (2x) off.
+
 Metric names used by the instrumented paths:
 
     trainer.compiles_total            counter  jit cache-miss compiles
@@ -43,25 +58,56 @@ Metric names used by the instrumented paths:
     engine.cpu_degraded_coalitions    counter  coalitions trained there
     engine.faults_injected            counter  faults fired by the
                                                MPLC_TPU_FAULT_PLAN hook
+    obs.memory_sample_errors          counter  sample_device_memory
+                                               failures (warned once)
+    obs.flight_dumps                  counter  flight-recorder postmortems
+                                               written (obs/flight.py)
+
+Per-tenant SLO series (service/scheduler.py, labeled `tenant=...`):
+
+    service.queue_wait_sec            histogram submit -> first quantum
+    service.time_to_first_value_sec   histogram submit -> first streamed
+                                               v(S)
+    service.slice_sec                 histogram scheduling-quantum span
+    service.deadline_misses           counter  jobs cancelled past their
+                                               deadline_sec
+    service.job_retries               counter  failed attempts re-queued
+    service.job_attempts              histogram attempts at job terminal
 
 `snapshot()` exports the whole registry as a plain dict (JSON-ready);
-`reset()` clears it (tests and per-run report boundaries).
+`reset()` clears it (tests and per-run report boundaries);
+`export_view()` returns structured rows (name, labels, kind, values) for
+the Prometheus renderer.
 """
 
 from __future__ import annotations
 
+import bisect
 import math
 import threading
 
 _lock = threading.Lock()
 _registry: dict = {}
 
+# Fixed log2 bucket upper bounds shared by every histogram: 2^-20
+# (~0.95 us) .. 2^12 (4096). Seconds-scale latencies, fractions in [0,1]
+# and small counts all land inside; anything larger goes to +Inf.
+LOG_BUCKET_BOUNDS = tuple(2.0 ** e for e in range(-20, 13))
+
+
+def _key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
 
 class Counter:
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "labels", "value")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, labels: dict):
         self.name = name
+        self.labels = labels
         self.value = 0.0
 
     def inc(self, v: float = 1.0) -> None:
@@ -70,10 +116,11 @@ class Counter:
 
 
 class Gauge:
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "labels", "value")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, labels: dict):
         self.name = name
+        self.labels = labels
         self.value = None
 
     def set(self, v: float) -> None:
@@ -88,17 +135,22 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming count/sum/min/max/mean — enough for padding-waste and
-    batch-duration distributions without bucket-boundary bikeshedding."""
+    """Streaming count/sum/min/max plus fixed log2 bucket counts — enough
+    for padding-waste and latency distributions with exportable
+    p50/p95/p99, without per-metric bucket-boundary bikeshedding."""
 
-    __slots__ = ("name", "count", "total", "min", "max")
+    __slots__ = ("name", "labels", "count", "total", "min", "max",
+                 "bucket_counts")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, labels: dict):
         self.name = name
+        self.labels = labels
         self.count = 0
         self.total = 0.0
         self.min = math.inf
         self.max = -math.inf
+        # one count per LOG_BUCKET_BOUNDS entry, plus the +Inf bucket
+        self.bucket_counts = [0] * (len(LOG_BUCKET_BOUNDS) + 1)
 
     def observe(self, v: float) -> None:
         with _lock:
@@ -108,51 +160,103 @@ class Histogram:
                 self.min = v
             if v > self.max:
                 self.max = v
+            # le-inclusive, Prometheus-style: bucket i counts v <= bound_i
+            self.bucket_counts[bisect.bisect_left(LOG_BUCKET_BOUNDS, v)] += 1
+
+    def quantile(self, q: float) -> float | None:
+        """Log-bucket quantile estimate: the upper bound of the bucket
+        holding the q-th ranked observation, clamped to the observed
+        [min, max] (so tight distributions report exact-ish values and
+        the +Inf bucket degrades to the observed max). None when empty."""
+        with _lock:
+            return _locked_quantile(self, q)
 
 
-def _get(name: str, cls):
-    m = _registry.get(name)
+def _get(name: str, cls, labels: dict | None = None):
+    labels = dict(labels or {})
+    key = _key(name, labels)
+    m = _registry.get(key)
     if m is None:
         with _lock:
-            m = _registry.get(name)
+            m = _registry.get(key)
             if m is None:
-                m = _registry[name] = cls(name)
+                m = _registry[key] = cls(name, labels)
     if not isinstance(m, cls):
-        raise TypeError(f"metric {name!r} is a {type(m).__name__}, "
+        raise TypeError(f"metric {key!r} is a {type(m).__name__}, "
                         f"not a {cls.__name__}")
     return m
 
 
-def counter(name: str) -> Counter:
-    return _get(name, Counter)
+def counter(name: str, **labels) -> Counter:
+    return _get(name, Counter, labels)
 
 
-def gauge(name: str) -> Gauge:
-    return _get(name, Gauge)
+def gauge(name: str, **labels) -> Gauge:
+    return _get(name, Gauge, labels)
 
 
-def histogram(name: str) -> Histogram:
-    return _get(name, Histogram)
+def histogram(name: str, **labels) -> Histogram:
+    return _get(name, Histogram, labels)
 
 
 def snapshot() -> dict:
     """The whole registry as {counters, gauges, histograms} of plain
-    numbers — JSON-serializable, suitable for the sweep-report sidecar."""
+    numbers — JSON-serializable, suitable for the sweep-report sidecar.
+    Labeled metrics appear under their `name{k=v,...}` registry keys;
+    histogram entries carry log-bucket p50/p95/p99 estimates."""
     with _lock:
         out = {"counters": {}, "gauges": {}, "histograms": {}}
-        for name, m in sorted(_registry.items()):
+        for key, m in sorted(_registry.items()):
             if isinstance(m, Counter):
-                out["counters"][name] = m.value
+                out["counters"][key] = m.value
             elif isinstance(m, Gauge):
-                out["gauges"][name] = m.value
+                out["gauges"][key] = m.value
             else:
-                out["histograms"][name] = {
+                out["histograms"][key] = {
                     "count": m.count, "sum": m.total,
                     "min": m.min if m.count else None,
                     "max": m.max if m.count else None,
                     "mean": m.total / m.count if m.count else None,
+                    "p50": _locked_quantile(m, 0.50),
+                    "p95": _locked_quantile(m, 0.95),
+                    "p99": _locked_quantile(m, 0.99),
                 }
         return out
+
+
+def _locked_quantile(m: Histogram, q: float) -> float | None:
+    """Histogram.quantile body for callers already holding `_lock`."""
+    if not m.count:
+        return None
+    rank = max(1, math.ceil(q * m.count))
+    cum = 0
+    for i, c in enumerate(m.bucket_counts):
+        cum += c
+        if cum >= rank:
+            bound = (LOG_BUCKET_BOUNDS[i]
+                     if i < len(LOG_BUCKET_BOUNDS) else m.max)
+            return min(max(bound, m.min), m.max)
+    return m.max
+
+
+def export_view() -> list:
+    """Structured registry rows for the Prometheus renderer
+    (obs/export.py): `[{name, labels, kind, ...}]` with histogram rows
+    carrying the shared bucket bounds and per-bucket counts."""
+    with _lock:
+        rows = []
+        for key, m in sorted(_registry.items()):
+            row = {"name": m.name, "labels": dict(m.labels)}
+            if isinstance(m, Counter):
+                row.update(kind="counter", value=m.value)
+            elif isinstance(m, Gauge):
+                row.update(kind="gauge", value=m.value)
+            else:
+                row.update(kind="histogram", count=m.count, sum=m.total,
+                           bounds=LOG_BUCKET_BOUNDS,
+                           bucket_counts=list(m.bucket_counts))
+            rows.append(row)
+        return rows
 
 
 def reset() -> None:
@@ -160,9 +264,17 @@ def reset() -> None:
         _registry.clear()
 
 
+_mem_sample_warned = False
+
+
 def sample_device_memory(gauge_name: str = "engine.device_mem_high_water_bytes") -> None:
     """Record the device's peak allocated bytes via `memory_stats()` (a
-    host-side query, no sync). No-op on backends without the API (CPU)."""
+    host-side query, no sync). A backend without the API (CPU) returning
+    no stats is a silent no-op; an actual FAILURE (import error, dead
+    tunnel, runtime raise) is counted in `obs.memory_sample_errors` and
+    warned ONCE per process — a fleet whose memory telemetry silently
+    stopped is how an OOM postmortem ends up with no HBM data."""
+    global _mem_sample_warned
     try:
         import jax
         stats = jax.local_devices()[0].memory_stats()
@@ -171,5 +283,12 @@ def sample_device_memory(gauge_name: str = "engine.device_mem_high_water_bytes")
         peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
         if peak is not None:
             gauge(gauge_name).set_max(int(peak))
-    except Exception:
-        pass
+    except Exception as e:
+        counter("obs.memory_sample_errors").inc()
+        if not _mem_sample_warned:
+            _mem_sample_warned = True
+            import warnings
+            warnings.warn(
+                f"sample_device_memory failed ({e}); further failures are "
+                "counted in obs.memory_sample_errors without warning",
+                stacklevel=2)

@@ -34,7 +34,13 @@ quantities every perf PR needs as a measured before/after:
   - a service row (multi-tenant sweep-service runs): job outcomes
     (completed/quarantined/cancelled/recovered), the cross-tenant
     packed-batch count, and per-tenant fair-share cost attribution from
-    the `service.slice` spans' batch accounting.
+    the `service.slice` spans' batch accounting;
+  - an slo row (service runs): per-tenant latency quantiles — queue wait
+    (submit -> first quantum) and time-to-first-value from the terminal
+    `service.job` events, slice-duration p50/p95/p99 from the
+    `service.slice` spans — plus deadline misses and re-queued attempts
+    (`service.job_fault`), mirroring the live per-tenant histograms the
+    /metrics endpoint exports (obs/export.py).
 
 The report is derived from SPANS of the collected region only, so callers
 get a clean per-run view without resetting the process-global metrics
@@ -49,6 +55,17 @@ import os
 
 def _attrs(rec: dict) -> dict:
     return rec.get("attrs") or {}
+
+
+def _pctl(values: list, q: float) -> float | None:
+    """Nearest-rank percentile of a small sample (exact, no buckets —
+    the report works from the collected region's full duration lists,
+    unlike the live /metrics histograms)."""
+    if not values:
+        return None
+    vals = sorted(values)
+    rank = max(1, -(-int(q * 100) * len(vals) // 100))  # ceil without math
+    return vals[min(rank, len(vals)) - 1]
 
 
 def sweep_report(records: list, metrics_snapshot: dict | None = None,
@@ -80,6 +97,8 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     faults_injected = 0
     svc_tenants: dict = {}
     svc_jobs: dict = {}
+    svc_slice_durs: dict = {}   # tenant -> [slice seconds]
+    svc_job_faults: dict = {}   # tenant -> failed-attempt count
     trust = None
     per_method: dict = {}
     recon_batches = recon_coalitions = 0
@@ -200,9 +219,16 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             t["samples"] += int(a.get("samples", 0))
             t["packed_batches"] += int(a.get("packed_batches", 0))
             t["seconds"] += dur
+            svc_slice_durs.setdefault(a.get("tenant", "?"), []).append(dur)
         elif name == "service.job":
             # terminal job event (completed / quarantined / cancelled)
             svc_jobs[a.get("job", "?")] = a
+        elif name == "service.job_fault" and a.get("requeued"):
+            # only RE-QUEUED attempts count as retries (the quarantining
+            # final attempt does not) — same rule as the live
+            # service.job_retries counter this row mirrors
+            tn = a.get("tenant", "?")
+            svc_job_faults[tn] = svc_job_faults.get(tn, 0) + 1
         elif name == "contrib.trust":
             # one trust row per sweep; the last event wins (a re-run of
             # the estimator within one collected region supersedes)
@@ -371,6 +397,38 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
                                            if total_s else None)}
                 for name, t in sorted(svc_tenants.items())},
         }
+        # the per-tenant SLO view: exact quantiles over the collected
+        # region (the live /metrics endpoint serves the same series as
+        # log-bucket histograms). Old record streams (pre-SLO
+        # service.job events) simply have empty latency lists.
+        slo: dict = {}
+        tenants = (set(svc_slice_durs) | set(svc_job_faults)
+                   | {a.get("tenant", "?") for a in svc_jobs.values()})
+        for tn in sorted(tenants):
+            jobs = [a for a in svc_jobs.values()
+                    if a.get("tenant", "?") == tn]
+            qw = [a["queue_wait_sec"] for a in jobs
+                  if a.get("queue_wait_sec") is not None]
+            ttfv = [a["ttfv_sec"] for a in jobs
+                    if a.get("ttfv_sec") is not None]
+            sl = svc_slice_durs.get(tn, [])
+            slo[tn] = {
+                "jobs": len(jobs),
+                "queue_wait_s": {"p50": _pctl(qw, 0.50),
+                                 "p95": _pctl(qw, 0.95),
+                                 "max": max(qw) if qw else None},
+                "ttfv_s": {"p50": _pctl(ttfv, 0.50),
+                           "p95": _pctl(ttfv, 0.95),
+                           "max": max(ttfv) if ttfv else None},
+                "slice_s": {"count": len(sl),
+                            "p50": _pctl(sl, 0.50),
+                            "p95": _pctl(sl, 0.95),
+                            "p99": _pctl(sl, 0.99)},
+                "deadline_misses": sum(
+                    1 for a in jobs if a.get("deadline_missed")),
+                "retries": svc_job_faults.get(tn, 0),
+            }
+        report["slo"] = slo
     if trust is not None:
         report["trust"] = trust
     if fits:
@@ -469,6 +527,21 @@ def format_report(report: dict) -> str:
                 f"samples={t['samples']}  span={t['seconds']:.2f}s  "
                 "share="
                 + (f"{share:.1%}" if share is not None else "n/a"))
+    slo = report.get("slo")
+    if slo:
+        def _q(d, k):
+            v = d.get(k)
+            return f"{v:.3f}" if v is not None else "n/a"
+        for name, s in sorted(slo.items()):
+            qw, tf, sl = s["queue_wait_s"], s["ttfv_s"], s["slice_s"]
+            lines.append(
+                f"  slo[{name}]  jobs={s['jobs']}  "
+                f"queue_wait p50/p95={_q(qw, 'p50')}/{_q(qw, 'p95')}s  "
+                f"ttfv p50={_q(tf, 'p50')}s  "
+                f"slice p50/p95/p99={_q(sl, 'p50')}/{_q(sl, 'p95')}/"
+                f"{_q(sl, 'p99')}s  "
+                f"deadline_misses={s['deadline_misses']}  "
+                f"retries={s['retries']}")
     rc = report.get("reconstruction")
     if rc is not None:
         mem = rc.get("recorded_update_bytes")

@@ -27,10 +27,26 @@ Nesting is per-thread (a thread-local span stack); `parent` links a span to
 the innermost span open on the same thread when it started. File writes are
 serialized by a module lock, so concurrent threads interleave whole lines,
 never partial ones.
+
+Every closed span/event record is ALSO appended to a bounded in-memory
+ring (`MPLC_TPU_FLIGHT_RECORDER_SIZE` records, default 512) regardless of
+sinks — the crash flight recorder (obs/flight.py) dumps it alongside a
+metrics snapshot when a job quarantines, a degrade ladder exhausts, or a
+journal turns out corrupt. The ring costs one dict build + deque append
+per record (no serialization, no I/O); the rest of the instrumentation
+stays a no-op without an active sink.
+
+The JSONL sink is flushed and closed from an `atexit` hook, so the final
+line of a trace survives normal interpreter exit; a hard kill can still
+tear the last line, which `obs/chrome_trace.py` tolerates and reports.
+The same hook converts the trace to Chrome trace-event JSON when
+`MPLC_TPU_CHROME_TRACE_FILE` names an output path.
 """
 
 from __future__ import annotations
 
+import atexit
+import collections
 import itertools
 import json
 import os
@@ -47,6 +63,75 @@ _sink_state: dict = {"path": None, "file": None}
 _collectors: list[list] = []
 
 TRACE_FILE_ENV = "MPLC_TPU_TRACE_FILE"
+FLIGHT_SIZE_ENV = "MPLC_TPU_FLIGHT_RECORDER_SIZE"
+
+
+# The span-name registry: EVERY literal name passed to span()/start_span()
+# /event() in the package, bench and scripts must be listed here (enforced
+# by the static scan in tests/test_knob_hygiene.py). The registry is what
+# keeps trace CONSUMERS — obs/report.py, obs/chrome_trace.py, the
+# projection scripts — from silently drifting away from the
+# instrumentation: renaming a span without updating its consumers (or this
+# table) is a fast-tier test failure, not a quietly empty report row.
+SPAN_REGISTRY = {
+    "engine.evaluate": "one CharacteristicEngine.evaluate() call "
+                       "(attrs: requested/missing, optional method)",
+    "engine.prep": "whole-call host-side batch construction",
+    "engine.dispatch": "device dispatch of one coalition batch",
+    "engine.harvest": "result fetch (device sync) of one batch",
+    "engine.batch": "per-batch accounting event (dispatch->harvest dur; "
+                    "attrs: ordinal/width/slot_count/coalitions/padding/"
+                    "epochs/samples/partner_passes)",
+    "engine.hbm": "per-evaluate HBM/donation footprint snapshot",
+    "engine.retry": "transient-failure retry (attrs: site/attempt/"
+                    "backoff_sec/ordinal)",
+    "engine.degrade": "OOM ladder rung (attrs: action=halve_cap|"
+                      "cpu_fallback|ladder_exhausted)",
+    "engine.fault": "injected fault fired (MPLC_TPU_FAULT_PLAN)",
+    "trainer.compile": "jit cache-miss compile (externally timed)",
+    "bank.compile": "program-bank AOT compile (attrs: overlapped)",
+    "bank.wait": "serial stall behind the bank's background compiler",
+    "recon.record": "grand-coalition recording run (retrain-free)",
+    "contributivity": "one estimator method end-to-end",
+    "contrib.trust": "trust row (CIs + rank stability)",
+    "mpl.fit": "one multi-partner fit",
+    "service.submit": "job accepted onto the service queue",
+    "service.reject": "admission refused (backpressure or fault plan)",
+    "service.slice": "one scheduling quantum of one job",
+    "service.stall": "injected scheduler stall (service fault plan)",
+    "service.job": "terminal job event (attrs incl. SLO: queue_wait_sec/"
+                   "ttfv_sec/deadline_missed)",
+    "service.job_fault": "one failed job attempt (pre retry/quarantine)",
+    "service.recover": "journal-seeded job recovery",
+    "service.journal_broken": "WAL append failure (journaling disabled)",
+    "flight.dump": "flight-recorder postmortem written (attrs: reason/"
+                   "path)",
+}
+
+
+def _flight_size() -> int:
+    raw = os.environ.get(FLIGHT_SIZE_ENV)
+    if raw:
+        try:
+            n = int(raw)
+            if n > 0:
+                return n
+        except ValueError:
+            pass
+        import warnings
+        warnings.warn(f"{FLIGHT_SIZE_ENV}={raw!r} is not a positive "
+                      "integer; using 512", stacklevel=2)
+    return 512
+
+
+# Always-on bounded ring of recent records for the crash flight recorder.
+# Sized once at import (the ring is process-global state, like the ids).
+_flight_ring: collections.deque = collections.deque(maxlen=_flight_size())
+
+
+def flight_records() -> list:
+    """The flight-recorder ring's current contents, oldest first."""
+    return list(_flight_ring)
 
 
 def _stack() -> list:
@@ -60,7 +145,12 @@ def _sink_file():
     """The open JSONL sink, or None. Re-opens when the env var changed.
     An unopenable path degrades to a one-time warning, never an exception
     into the instrumented hot path (the path stays recorded so the failed
-    open is not retried on every span)."""
+    open is not retried on every span). After the atexit close the sink
+    stays closed for good — a daemon thread emitting during interpreter
+    shutdown must not reopen the file the exit hook just finished (and
+    may be converting)."""
+    if _sink_state.get("closed"):
+        return None
     path = os.environ.get(TRACE_FILE_ENV) or None
     if path == _sink_state["path"]:
         return _sink_state["file"]
@@ -84,6 +174,10 @@ def _sink_file():
 
 
 def _emit(record: dict) -> None:
+    # the flight ring sees EVERY record, sink or not (deque.append is
+    # atomic; maxlen bounds it) — the crash recorder must hold the spans
+    # of a failure nobody was tracing on purpose
+    _flight_ring.append(record)
     f = _sink_file()
     if f is None and not _collectors:
         return
@@ -91,12 +185,14 @@ def _emit(record: dict) -> None:
         for c in _collectors:
             c.append(record)
         if f is not None:
-            f.write(json.dumps(record) + "\n")
-            f.flush()
-
-
-def _active() -> bool:
-    return bool(_collectors) or bool(os.environ.get(TRACE_FILE_ENV))
+            try:
+                f.write(json.dumps(record) + "\n")
+                f.flush()
+            except ValueError:
+                # a record emitted after the atexit hook closed the sink
+                # (daemon threads unwinding): the ring has it, drop the
+                # file write
+                _sink_state["file"] = None
 
 
 class Span:
@@ -134,10 +230,11 @@ class Span:
         self.duration = time.perf_counter() - self._t0
         self._closed = True
         self._pop()
-        if _active():
-            _emit({"name": self.name, "id": self.id, "parent": self.parent,
-                   "ts": self.ts, "dur": self.duration,
-                   "thread": threading.get_ident(), "attrs": self.attrs})
+        # record built unconditionally: the flight ring is always on
+        # (one dict per span; sinks/collectors still gate serialization)
+        _emit({"name": self.name, "id": self.id, "parent": self.parent,
+               "ts": self.ts, "dur": self.duration,
+               "thread": threading.get_ident(), "attrs": self.attrs})
         return self
 
     def cancel(self) -> None:
@@ -180,13 +277,18 @@ def active_span(name: str) -> "Span | None":
 
 def event(name: str, dur: float = 0.0, **attrs) -> None:
     """Emit a point-in-time (or externally timed) record without opening a
-    span — e.g. a compile whose duration was measured by the caller."""
-    if not _active():
-        return
+    span — e.g. a compile whose duration was measured by the caller.
+    Always lands in the flight ring; sinks/collectors only when active.
+
+    `ts` is backdated by `dur` so it marks the interval's START, matching
+    span records (events are emitted AFTER the measured work — an
+    engine.batch fires at harvest end). Timeline consumers (the Perfetto
+    exporter) would otherwise draw every externally timed slice one full
+    duration too late."""
     st = _stack()
     _emit({"name": name, "id": next(_ids),
            "parent": st[-1].id if st else None,
-           "ts": time.time(), "dur": float(dur),
+           "ts": time.time() - float(dur), "dur": float(dur),
            "thread": threading.get_ident(), "attrs": attrs})
 
 
@@ -213,3 +315,33 @@ class collect:
             except ValueError:
                 pass
         return False
+
+
+@atexit.register
+def _close_sink_at_exit() -> None:
+    """Flush + close the JSONL sink on interpreter exit, so the final
+    span of a run is a complete line (a torn tail after a crash is
+    invisible to line-oriented tooling — the chrome_trace converter
+    tolerates one, but a normal exit should never produce one). When
+    `MPLC_TPU_CHROME_TRACE_FILE` is set alongside the trace file, the
+    finished JSONL is converted to Chrome trace-event JSON in the same
+    hook (the live-export counterpart of scripts/trace_to_perfetto.py)."""
+    with _lock:
+        f, _sink_state["file"] = _sink_state["file"], None
+        _sink_state["path"] = None
+        _sink_state["closed"] = True  # _sink_file stays None from here on
+    if f is not None:
+        try:
+            f.flush()
+            f.close()
+        except (OSError, ValueError):
+            pass
+    src = os.environ.get(TRACE_FILE_ENV)
+    out = os.environ.get("MPLC_TPU_CHROME_TRACE_FILE")
+    if src and out and os.path.exists(src):
+        try:
+            from .chrome_trace import convert
+            convert(src, out)
+        except Exception as e:  # never let telemetry break exit
+            import warnings
+            warnings.warn(f"Chrome-trace export to {out!r} failed: {e}")

@@ -140,12 +140,22 @@ class SweepJournal:
                 else:
                     if bad_at is not None:
                         # a good record AFTER a bad one: not a torn
-                        # append — history itself is corrupt
+                        # append — history itself is corrupt. Dump the
+                        # flight recorder first: the postmortem captures
+                        # what the process was doing when it found its
+                        # own history rewritten
+                        from ..obs import flight as obs_flight
+                        postmortem = obs_flight.dump(
+                            "journal_corrupt",
+                            extra={"journal": path, "offset": bad_at[0],
+                                   "reason": bad_at[1]})
                         raise JournalCorruptError(
                             f"journal {path} has a corrupt record at byte "
                             f"{bad_at[0]} ({bad_at[1]}) followed by valid "
                             "records — this is not a torn tail; refusing "
-                            "to replay selectively")
+                            "to replay selectively"
+                            + (f" (postmortem flight record: {postmortem})"
+                               if postmortem else ""))
                     records.append(rec)
                     good_end = min(line_end, len(raw))
             offset = line_end

@@ -52,6 +52,17 @@ The headline is the fault model, not the queue:
   to an uninterrupted run (same per-coalition rng-fold streams; the
   engine's batch composition never affects v(S)).
 
+Live telemetry: when `MPLC_TPU_METRICS_PORT` is set, constructing a
+service starts the obs/export.py HTTP plane — /metrics (Prometheus,
+incl. the per-tenant SLO histograms instrumented here: queue wait,
+time-to-first-value, slice duration, deadline misses, retries),
+/healthz (worker heartbeat age; 503 when a running job's quantum stalls
+past STALL_HEALTHY_SEC) and /varz (the per-job state table via
+`varz_view`). With it unset no thread or socket exists; `health_view()`
+and `varz_view()` remain directly callable either way. Quarantines dump
+the crash flight recorder (obs/flight.py) and reference the postmortem
+file from the quarantine log line.
+
 Deterministic testability: `MPLC_TPU_SERVICE_FAULT_PLAN` (faults.py)
 addresses jobs by submission ordinal — `crash@job2:batch3` installs an
 injected crash into job 2's private engine injector, `reject@job4` makes
@@ -63,6 +74,7 @@ indistinguishable from slow compute for whoever is behind it in line).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
@@ -71,12 +83,23 @@ from collections import deque
 import numpy as np
 
 from .. import constants, faults
+from ..obs import export as obs_export
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from .journal import SweepJournal
 from .packer import CrossTenantPacker
 
 logger = logging.getLogger("mplc_tpu")
+
+# /healthz stall rule: the service is unhealthy when a job is RUNNING and
+# the worker heartbeat (beaten at every quantum start and every batch
+# boundary) is older than this — a single device batch legitimately
+# longer than the bound would false-flag, so it is generous. Idle
+# services (no running job) are healthy at any heartbeat age.
+STALL_HEALTHY_SEC = 30.0
+
+_SERVICE_IDS = itertools.count(1)
 
 
 class ServiceError(RuntimeError):
@@ -134,6 +157,11 @@ class SweepJob:
         self.values: "dict | None" = None
         self.error: "BaseException | None" = None
         self.submitted_at = time.monotonic()
+        # SLO landmarks (per-tenant histograms + the report's slo row):
+        # first scheduling quantum (queue wait) and first streamed value
+        self.first_quantum_at: "float | None" = None
+        self.first_value_at: "float | None" = None
+        self.deadline_missed = False
         self._done = threading.Event()
         self._journal_cursor = 0    # items of charac_fct_values journaled
         self._cancel_raised = False
@@ -183,9 +211,31 @@ class SweepJob:
     # -- service-side helpers -------------------------------------------
 
     def _push_stream(self, items) -> None:
+        items = list(items)
         with self._stream_lock:
             self._stream.extend(items)
             self._stream_lock.notify_all()
+        if items and self.first_value_at is None:
+            # time-to-first-value: submit -> the first v(S) a consumer
+            # could observe (journal-recovered seeds count — the tenant
+            # sees them just the same)
+            self.first_value_at = time.monotonic()
+            obs_metrics.histogram(
+                "service.time_to_first_value_sec",
+                tenant=self.tenant).observe(
+                    self.first_value_at - self.submitted_at)
+
+    def _slo_attrs(self) -> dict:
+        """SLO fields attached to the terminal `service.job` event (the
+        report's slo row reads them back out)."""
+        return {
+            "queue_wait_sec": (self.first_quantum_at - self.submitted_at
+                               if self.first_quantum_at is not None
+                               else None),
+            "ttfv_sec": (self.first_value_at - self.submitted_at
+                         if self.first_value_at is not None else None),
+            "deadline_missed": self.deadline_missed,
+        }
 
     def _finish(self) -> None:
         with self._stream_lock:
@@ -219,6 +269,21 @@ class SweepService:
                            constants.SERVICE_SLICE_ENV, 16))
         self._max_job_retries = constants._env_positive_int(
             constants.MAX_RETRIES_ENV, 3)
+        self._heartbeat = time.monotonic()
+        # live telemetry plane: the /metrics//healthz//varz endpoints
+        # exist ONLY when MPLC_TPU_METRICS_PORT is set (no thread, no
+        # socket otherwise); health/varz providers register either way,
+        # so an embedding process can poll them directly
+        self._export = obs_export.maybe_start_from_env()
+        self._provider_key = f"service{next(_SERVICE_IDS)}"
+        # WeakMethod: a service dropped without shutdown() must not keep
+        # reporting into /healthz //varz forever (shutdown unregisters
+        # explicitly; the weakref covers the leak path)
+        import weakref
+        obs_export.register_health(self._provider_key,
+                                   weakref.WeakMethod(self.health_view))
+        obs_export.register_varz(self._provider_key,
+                                 weakref.WeakMethod(self.varz_view))
 
         # journal replay BEFORE the append handle opens: a restart reads
         # history (quarantining a torn tail), then appends to it
@@ -264,6 +329,61 @@ class SweepService:
             self._recovered[job]["quarantined"] = True
         elif kind == "cancel" and job in self._recovered:
             self._recovered[job]["cancelled"] = True
+
+    # -- live telemetry providers ---------------------------------------
+
+    def health_view(self) -> dict:
+        """The /healthz provider: worker liveness, heartbeat age, queue
+        depth and journal status. `healthy` flips False when the worker
+        thread died, or when a job is running and the heartbeat (beaten
+        at quantum starts and batch boundaries) is staler than
+        STALL_HEALTHY_SEC — a wedged quantum, an injected stall, a hung
+        device. An idle service is healthy at any age."""
+        now = time.monotonic()
+        age = now - self._heartbeat
+        with self._lock:
+            running = self._running_job
+            queue_depth = len(self._queue)
+            pending = sum(1 for j in self._jobs.values() if not j.done)
+            closed = self._closed
+        worker_alive = self._worker is None or self._worker.is_alive()
+        stalled = running is not None and age > STALL_HEALTHY_SEC
+        return {
+            "healthy": worker_alive and not stalled,
+            "worker_alive": worker_alive,
+            "worker_heartbeat_age_sec": age,
+            "stalled": stalled,
+            "running_job": running.job_id if running is not None else None,
+            "queue_depth": queue_depth,
+            "jobs_pending": pending,
+            "closed": closed,
+            "journal": ("disabled" if self._journal is None
+                        else "broken" if self._journal_broken else "ok"),
+        }
+
+    def varz_view(self) -> dict:
+        """The /varz provider: the full engine-state snapshot — per-job
+        status table plus the scheduler's admission/queue knobs."""
+        with self._lock:
+            jobs = {
+                job_id: {
+                    "tenant": j.tenant, "method": j.method,
+                    "status": j.status, "attempts": j.attempts,
+                    "ordinal": j.ordinal,
+                    "values_streamed": len(j._stream),
+                    "packed_batches": j.packed_batches,
+                    "recovered_values": j.recovered_values,
+                    "deadline_sec": j.deadline_sec,
+                    "age_sec": time.monotonic() - j.submitted_at,
+                } for job_id, j in self._jobs.items()}
+            return {
+                "jobs": jobs,
+                "queue_depth": len(self._queue),
+                "max_pending": self._max_pending,
+                "slice_coalitions": self._slice,
+                "closed": self._closed,
+                "recovered_jobs": len(self._recovered),
+            }
 
     def recovered_jobs(self) -> list:
         """Descriptors of journaled submissions from previous service
@@ -431,6 +551,7 @@ class SweepService:
             self._worker = None
         if self._journal is not None:
             self._journal.close()
+        obs_export.unregister(self._provider_key)
 
     def __enter__(self) -> "SweepService":
         return self
@@ -446,6 +567,15 @@ class SweepService:
         re-queued (work remains), False on any terminal state. EVERY
         failure is contained here: nothing a job does may unwind into
         the scheduler loop (per-tenant isolation)."""
+        self._heartbeat = time.monotonic()
+        if job.first_quantum_at is None:
+            # queue wait: submit -> the scheduler first picks the job up
+            # (the injected stall below bills against the job's SLICE
+            # time, like any slow quantum, not its queue wait)
+            job.first_quantum_at = time.monotonic()
+            obs_metrics.histogram(
+                "service.queue_wait_sec", tenant=job.tenant).observe(
+                    job.first_quantum_at - job.submitted_at)
         entry = self._plan.get(job.ordinal)
         if entry is not None and entry.get("stall_sec"):
             sec, entry["stall_sec"] = entry["stall_sec"], 0.0
@@ -455,6 +585,7 @@ class SweepService:
                            sec, job.job_id)
             time.sleep(sec)
         if job._deadline_expired():
+            self._note_deadline_miss(job)
             self._terminal(job, "cancelled", JobCancelled(
                 f"job {job.job_id} exceeded deadline_sec="
                 f"{job.deadline_sec} before its quantum"))
@@ -480,6 +611,9 @@ class SweepService:
                 samples=eng.samples_trained - s0,
                 packed_batches=job.packed_batches - p0)
             span.end()
+            obs_metrics.histogram(
+                "service.slice_sec", tenant=job.tenant).observe(
+                    span.duration)
             if finished:
                 self._complete(job)
                 return False
@@ -513,10 +647,17 @@ class SweepService:
         job.attempts += 1
         retryable = (faults.is_transient(err) or faults.is_oom(err)
                      or isinstance(err, faults.InjectedCrash))
+        requeued = retryable and job.attempts <= self._max_job_retries
+        # `requeued` distinguishes a retry from the quarantining final
+        # attempt, so the report's slo row counts exactly what the live
+        # service.job_retries counter counts
         obs_trace.event("service.job_fault", tenant=job.tenant,
                         job=job.job_id, attempt=job.attempts,
-                        retryable=retryable, error=str(err)[:200])
-        if retryable and job.attempts <= self._max_job_retries:
+                        retryable=retryable, requeued=requeued,
+                        error=str(err)[:200])
+        if requeued:
+            obs_metrics.counter("service.job_retries",
+                                tenant=job.tenant).inc()
             logger.warning(
                 "service: job %s attempt %d failed (%s) — re-queueing "
                 "(its harvested values persist; the continuation is "
@@ -524,8 +665,18 @@ class SweepService:
             return True
         kind = ("retry budget exhausted" if retryable
                 else "permanent failure")
-        logger.error("service: quarantining job %s after %s: %s",
-                     job.job_id, kind, err)
+        # postmortem BEFORE the terminal bookkeeping: the flight ring
+        # still holds the failing attempt's spans (engine.dispatch /
+        # engine.fault / service.job_fault of the batch that died)
+        postmortem = obs_flight.dump("job_quarantined", extra={
+            "job": job.job_id, "tenant": job.tenant,
+            "attempts": job.attempts, "kind": kind,
+            "error": str(err)[:500]})
+        logger.error(
+            "service: quarantining job %s after %s: %s%s",
+            job.job_id, kind, err,
+            f" — postmortem flight record: {postmortem}"
+            if postmortem else "")
         q = JobQuarantined(
             f"job {job.job_id} quarantined ({kind}, "
             f"{job.attempts} attempt(s)): {err}")
@@ -605,6 +756,7 @@ class SweepService:
         harvested, count cross-tenant packed batches, and enforce the
         deadline cooperatively — raising BETWEEN batches, never inside a
         dispatch."""
+        self._heartbeat = time.monotonic()
         self._journal_new_values(job)
         if job._slice_packed.get(slot_count):
             job.packed_batches += 1
@@ -614,6 +766,7 @@ class SweepService:
             # this hook for the in-flight batch, and a second raise there
             # would abort the drain's bookkeeping
             job._cancel_raised = True
+            self._note_deadline_miss(job)
             raise JobCancelled(
                 f"job {job.job_id} exceeded deadline_sec="
                 f"{job.deadline_sec} (cancelled at a batch boundary)")
@@ -695,6 +848,12 @@ class SweepService:
 
     # -- terminal states -------------------------------------------------
 
+    def _note_deadline_miss(self, job: SweepJob) -> None:
+        if not job.deadline_missed:
+            job.deadline_missed = True
+            obs_metrics.counter("service.deadline_misses",
+                                tenant=job.tenant).inc()
+
     def _release_engine_data(self, job: SweepJob) -> None:
         """Drop the completed job's device-resident state (stacked data,
         eval sets, pipelines, bank view) while KEEPING the engine object
@@ -732,12 +891,15 @@ class SweepService:
         job.status = "completed"
         self._journal_safe({"type": "done", "job": job.job_id})
         obs_metrics.counter("service.jobs_completed").inc()
+        obs_metrics.histogram("service.job_attempts",
+                              tenant=job.tenant).observe(job.attempts)
         obs_trace.event(
             "service.job", tenant=job.tenant, job=job.job_id,
             status="completed", attempts=job.attempts,
             recovered=job.recovered_values > 0,
             packed_batches=job.packed_batches,
-            seconds=time.monotonic() - job.submitted_at)
+            seconds=time.monotonic() - job.submitted_at,
+            **job._slo_attrs())
         self._release_engine_data(job)
         self._retire(job)
         job._finish()
@@ -757,12 +919,14 @@ class SweepService:
         counter = ("service.jobs_cancelled" if status == "cancelled"
                    else "service.jobs_quarantined")
         obs_metrics.counter(counter).inc()
+        obs_metrics.histogram("service.job_attempts",
+                              tenant=job.tenant).observe(job.attempts)
         obs_trace.event(
             "service.job", tenant=job.tenant, job=job.job_id,
             status=status, attempts=job.attempts,
             recovered=job.recovered_values > 0,
             packed_batches=job.packed_batches,
             seconds=time.monotonic() - job.submitted_at,
-            error=str(err)[:200])
+            error=str(err)[:200], **job._slo_attrs())
         self._retire(job)
         job._finish()

@@ -14,6 +14,11 @@ lower bound from XLA's cost model; the busy fraction here is the measured
 answer to "where do the other ~96% of peak go" — on this workload the gap
 is device idle (per-batch dispatch latency over the tunnel) plus tiny-op
 overhead, not slow matmuls.
+
+This tool reads XLA-level xplane traces only. For the SPAN-level view —
+the engine's own dispatch/harvest/retry instrumentation recorded to
+MPLC_TPU_TRACE_FILE — use scripts/trace_to_perfetto.py, which converts
+the span JSONL into Chrome trace-event JSON loadable in Perfetto.
 """
 
 import glob

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Convert a span JSONL trace (MPLC_TPU_TRACE_FILE) into Chrome
+trace-event JSON loadable in Perfetto (https://ui.perfetto.dev).
+
+Usage: python scripts/trace_to_perfetto.py <trace.jsonl> [-o out.json]
+
+The output shows the engine's compile/dispatch/harvest overlap as
+per-thread tracks (engine.batch and bank.compile slices side by side is
+the pipelining/AOT-overlap picture the sweep report only totals), with
+flow arrows linking retries, OOM degrades and service re-queues to the
+batches/slices they recovered. Tolerates a torn tail line (a process
+killed mid-append) and reports how many lines were skipped.
+
+For XLA-level device traces (*.xplane.pb from MPLC_TPU_PROFILE_DIR) use
+scripts/analyze_trace.py instead — this tool covers the span-level
+(host/scheduling) view.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mplc_tpu.obs.chrome_trace import convert  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="span JSONL -> Chrome trace-event JSON (Perfetto)")
+    ap.add_argument("trace", help="span JSONL file (MPLC_TPU_TRACE_FILE)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: <trace>.chrome.json)")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.trace):
+        ap.error(f"trace file not found: {args.trace}")
+    summary = convert(args.trace, args.out)
+    line = (f"{summary['out']}: {summary['events']} trace events from "
+            f"{summary['records']} records, {summary['flows']} flow links")
+    if summary["torn_lines"]:
+        line += f", {summary['torn_lines']} torn line(s) skipped"
+    print(line)
+    print("load it at https://ui.perfetto.dev (or chrome://tracing)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _flight_dumps_to_tmp(tmp_path, monkeypatch):
+    """The crash flight recorder defaults to the working directory; tests
+    exercising quarantine/ladder/journal-corrupt paths must drop their
+    postmortems in tmp, not the repo root."""
+    monkeypatch.setenv("MPLC_TPU_FLIGHT_RECORDER_DIR",
+                       str(tmp_path / "flight"))
+
+
 @pytest.fixture(scope="session")
 def tiny_image_dataset():
     """A small, learnable prototype-image dataset shared across tests."""

@@ -1,0 +1,48 @@
+"""scripts/analyze_trace.py argument/error handling, on a synthetic
+xplane-free path (the real ProfileData parse needs a device trace the
+fast tier cannot produce; the selection logic and the CLI error contract
+are the part a refactor silently breaks).
+"""
+
+import os
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT / "scripts"))
+
+import analyze_trace  # noqa: E402
+
+
+def test_file_path_passes_through(tmp_path):
+    pb = tmp_path / "direct.xplane.pb"
+    pb.write_bytes(b"")
+    assert analyze_trace.newest_xplane(str(pb)) == str(pb)
+
+
+def test_newest_xplane_picks_latest_recursively(tmp_path):
+    old = tmp_path / "a" / "one.xplane.pb"
+    new = tmp_path / "b" / "deep" / "two.xplane.pb"
+    for p in (old, new):
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(b"")
+    t = time.time()
+    os.utime(old, (t - 100, t - 100))
+    os.utime(new, (t, t))
+    assert analyze_trace.newest_xplane(str(tmp_path)) == str(new)
+
+
+def test_empty_dir_is_a_clean_cli_error(tmp_path):
+    with pytest.raises(SystemExit, match="no .*xplane.pb"):
+        analyze_trace.newest_xplane(str(tmp_path))
+
+
+def test_docstring_points_at_the_perfetto_exporter():
+    """The satellite contract: this tool covers XLA xplane traces only;
+    its docstring must direct span-level (MPLC_TPU_TRACE_FILE) users to
+    scripts/trace_to_perfetto.py."""
+    assert "trace_to_perfetto" in analyze_trace.__doc__
+    assert "MPLC_TPU_TRACE_FILE" in analyze_trace.__doc__

@@ -1,0 +1,194 @@
+"""The Perfetto exporter (obs/chrome_trace.py + scripts/trace_to_perfetto):
+span JSONL -> Chrome trace-event JSON, schema-validated, with
+retry/degrade/requeue flow events intact and torn-tail tolerance.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from mplc_tpu.obs import chrome_trace, metrics, trace
+
+ROOT = Path(__file__).resolve().parents[1]
+
+# the trace-event phases the converter may legally emit
+_PHASES = {"X", "M", "s", "f"}
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("MPLC_TPU_TRACE_FILE", raising=False)
+    monkeypatch.delenv("MPLC_TPU_CHROME_TRACE_FILE", raising=False)
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _validate_schema(doc):
+    """Minimal Chrome trace-event (JSON object form) schema check."""
+    assert isinstance(doc, dict)
+    assert isinstance(doc["traceEvents"], list)
+    for ev in doc["traceEvents"]:
+        assert set(ev) >= {"name", "ph", "ts", "pid", "tid"}, ev
+        assert ev["ph"] in _PHASES, ev
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 1.0  # zero-dur records widened to 1 us
+        if ev["ph"] in ("s", "f"):
+            assert "id" in ev
+        if ev["ph"] == "f":
+            assert ev.get("bp") == "e"
+    # flow pairs match up by id
+    starts = {e["id"] for e in doc["traceEvents"] if e["ph"] == "s"}
+    ends = {e["id"] for e in doc["traceEvents"] if e["ph"] == "f"}
+    assert starts == ends
+
+
+def test_synthetic_records_schema_and_flows(tmp_path):
+    recs = [
+        {"name": "engine.evaluate", "id": 1, "parent": None, "ts": 100.0,
+         "dur": 2.0, "thread": 7, "attrs": {"requested": 3}},
+        {"name": "engine.fault", "id": 2, "parent": 1, "ts": 100.1,
+         "dur": 0.0, "thread": 7,
+         "attrs": {"kind": "transient", "site": "dispatch", "ordinal": 1}},
+        {"name": "engine.retry", "id": 3, "parent": 1, "ts": 100.2,
+         "dur": 0.0, "thread": 7,
+         "attrs": {"site": "dispatch", "attempt": 1, "ordinal": 1}},
+        {"name": "engine.batch", "id": 4, "parent": 1, "ts": 100.5,
+         "dur": 0.4, "thread": 7, "attrs": {"ordinal": 1, "width": 8}},
+        # a different thread's batch with the same ordinal: must NOT be
+        # the flow target of thread 7's retry
+        {"name": "engine.batch", "id": 5, "parent": None, "ts": 100.3,
+         "dur": 0.1, "thread": 9, "attrs": {"ordinal": 1, "width": 8}},
+    ]
+    doc = chrome_trace.to_chrome(recs)
+    _validate_schema(doc)
+    # retry + fault both link to ordinal-1 batch on thread 7
+    flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "f")]
+    assert {e["name"] for e in flows} == {"retry", "fault"}
+    for e in flows:
+        assert e["tid"] == 7
+    # thread metadata present for both threads
+    meta = [e for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert {e["tid"] for e in meta} == {7, 9}
+    # timestamps rebased to the earliest record
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert min(e["ts"] for e in xs) == 0.0
+
+
+def test_requeue_flow_links_job_fault_to_next_slice():
+    recs = [
+        {"name": "service.slice", "id": 1, "parent": None, "ts": 10.0,
+         "dur": 0.5, "thread": 1, "attrs": {"job": "job1", "tenant": "a"}},
+        {"name": "service.job_fault", "id": 2, "parent": None, "ts": 10.6,
+         "dur": 0.0, "thread": 1, "attrs": {"job": "job1", "attempt": 1}},
+        {"name": "service.slice", "id": 3, "parent": None, "ts": 10.7,
+         "dur": 0.5, "thread": 1, "attrs": {"job": "job2", "tenant": "b"}},
+        {"name": "service.slice", "id": 4, "parent": None, "ts": 11.3,
+         "dur": 0.5, "thread": 1, "attrs": {"job": "job1", "tenant": "a"}},
+    ]
+    doc = chrome_trace.to_chrome(recs)
+    _validate_schema(doc)
+    finish = next(e for e in doc["traceEvents"] if e["ph"] == "f")
+    # the flow ends inside job1's NEXT slice (ts 11.3 -> rebased 1.3e6),
+    # not job2's earlier one
+    assert finish["name"] == "requeue"
+    assert 1.3e6 <= finish["ts"] < 1.3e6 + 10
+
+
+def test_real_sweep_jsonl_converts_with_retry_flows(tmp_path, monkeypatch):
+    """Acceptance: a real engine sweep's JSONL (with an injected
+    transient -> retry) converts to schema-valid Chrome JSON with the
+    retry flow intact."""
+    from helpers import build_scenario
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+
+    trace_file = tmp_path / "sweep.jsonl"
+    monkeypatch.setenv("MPLC_TPU_TRACE_FILE", str(trace_file))
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "transient@batch1")
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    sc = build_scenario(partners_count=3, dataset_name="titanic",
+                        epoch_count=2, gradient_updates_per_pass_count=2)
+    eng = CharacteristicEngine(sc)
+    eng.evaluate([(0,), (1,), (0, 1), (0, 1, 2)])
+    monkeypatch.delenv("MPLC_TPU_TRACE_FILE")
+    trace._sink_file()  # re-sync: closes the sink so the file is complete
+
+    summary = chrome_trace.convert(str(trace_file))
+    assert summary["torn_lines"] == 0
+    assert summary["records"] > 0
+    doc = json.loads(Path(summary["out"]).read_text())
+    _validate_schema(doc)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"engine.evaluate", "engine.dispatch", "engine.harvest",
+            "engine.batch"} <= names
+    # the injected transient produced fault+retry flows to batch 1
+    flows = {e["name"] for e in doc["traceEvents"] if e["ph"] == "s"}
+    assert {"retry", "fault"} <= flows
+    assert summary["flows"] >= 2
+
+
+def test_torn_tail_tolerated_and_reported(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    good = {"name": "engine.batch", "id": 1, "parent": None, "ts": 1.0,
+            "dur": 0.1, "thread": 1, "attrs": {}}
+    path.write_text(json.dumps(good) + "\n" + '{"name": "engine.ba')
+    with pytest.warns(UserWarning, match="torn tail"):
+        summary = chrome_trace.convert(str(path))
+    assert summary["torn_lines"] == 1
+    assert summary["records"] == 1
+    doc = json.loads(Path(summary["out"]).read_text())
+    _validate_schema(doc)
+    assert doc["otherData"]["torn_lines"] == 1
+
+
+def test_cli_script(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    rec = {"name": "engine.batch", "id": 1, "parent": None, "ts": 1.0,
+           "dur": 0.1, "thread": 1, "attrs": {"ordinal": 1}}
+    path.write_text(json.dumps(rec) + "\n")
+    out = tmp_path / "out.json"
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "trace_to_perfetto.py"),
+         str(path), "-o", str(out)],
+        capture_output=True, text=True, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr
+    assert "1 trace events" not in proc.stdout  # events incl. metadata
+    assert "perfetto" in proc.stdout
+    _validate_schema(json.loads(out.read_text()))
+    # a missing input is a clean CLI error, not a traceback
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "trace_to_perfetto.py"),
+         str(tmp_path / "nope.jsonl")],
+        capture_output=True, text=True, cwd=ROOT)
+    assert proc.returncode == 2
+    assert "not found" in proc.stderr
+
+
+def test_atexit_env_conversion(tmp_path):
+    """MPLC_TPU_CHROME_TRACE_FILE: the interpreter-exit hook converts the
+    span JSONL automatically (exercised in a child process, where the
+    atexit actually fires)."""
+    src = tmp_path / "t.jsonl"
+    out = tmp_path / "t.chrome.json"
+    code = (
+        "from mplc_tpu.obs import trace\n"
+        "with trace.span('engine.evaluate', requested=1):\n"
+        "    trace.event('engine.batch', dur=0.1, ordinal=1)\n"
+    )
+    import os
+    env = dict(os.environ, MPLC_TPU_TRACE_FILE=str(src),
+               MPLC_TPU_CHROME_TRACE_FILE=str(out),
+               JAX_PLATFORMS="cpu", PYTHONPATH=str(ROOT))
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr
+    assert out.exists()
+    doc = json.loads(out.read_text())
+    _validate_schema(doc)
+    assert {e["name"] for e in doc["traceEvents"]} >= {
+        "engine.evaluate", "engine.batch"}

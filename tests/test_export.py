@@ -1,0 +1,342 @@
+"""The live telemetry plane (obs/export.py + obs/flight.py): Prometheus
+rendering, the /metrics //healthz //varz endpoints gated on
+MPLC_TPU_METRICS_PORT, per-tenant SLO histograms + the report's slo row,
+and the crash flight recorder's postmortem dumps.
+
+Acceptance invariants pinned here:
+  - with the port set, a running SweepService serves Prometheus-parseable
+    /metrics including per-tenant SLO histogram series, /varz with the
+    job table, and /healthz that flips 503 on a worker stall;
+  - with the port UNSET, no thread or socket is created;
+  - a quarantined job writes a postmortem flight-recorder file whose
+    ring buffer contains the failing batch's spans, referenced from the
+    quarantine log line.
+"""
+
+import json
+import logging
+import os
+import time
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mplc_tpu.obs import export, flight, metrics, report, trace
+from mplc_tpu.service import JobQuarantined, SweepService
+from mplc_tpu.service import scheduler as sched
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in ("MPLC_TPU_METRICS_PORT", "MPLC_TPU_SERVICE_FAULT_PLAN",
+              "MPLC_TPU_FAULT_PLAN", "MPLC_TPU_MAX_RETRIES"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    metrics.reset()
+    yield
+    export.stop()
+    metrics.reset()
+
+
+def _scenario(seed=0):
+    from helpers import build_scenario
+    return build_scenario(partners_count=3, dataset_name="titanic",
+                          epoch_count=2,
+                          gradient_updates_per_pass_count=2, seed=seed)
+
+
+def _get(url):
+    try:
+        resp = urllib.request.urlopen(url, timeout=10)
+        return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:  # 503 still carries a body
+        return e.code, e.read().decode()
+
+
+# -- Prometheus rendering -----------------------------------------------------
+
+def test_prometheus_text_labels_buckets_and_types():
+    metrics.counter("engine.retries").inc(3)
+    metrics.gauge("engine.device_mem_high_water_bytes").set(1024)
+    metrics.counter("trainer.compiles[brun]").inc()
+    h = metrics.histogram("service.queue_wait_sec", tenant="t0")
+    for v in (0.001, 0.002, 4.0):
+        h.observe(v)
+    text = export.prometheus_text()
+    lines = text.splitlines()
+    assert "# TYPE mplc_engine_retries counter" in lines
+    assert "mplc_engine_retries 3" in lines
+    assert "mplc_engine_device_mem_high_water_bytes 1024" in lines
+    # the name[item] convention becomes an item label
+    assert 'mplc_trainer_compiles{item="brun"} 1' in lines
+    # histogram: cumulative buckets, +Inf, _sum/_count, labels quoted
+    assert "# TYPE mplc_service_queue_wait_sec histogram" in lines
+    inf = [l for l in lines if l.startswith(
+        'mplc_service_queue_wait_sec_bucket{le="+Inf"')]
+    assert inf and inf[0].endswith(" 3")
+    assert 'tenant="t0"' in inf[0]
+    assert 'mplc_service_queue_wait_sec_count{tenant="t0"} 3' in lines
+    # bucket counts are CUMULATIVE and monotone
+    buckets = [int(l.rsplit(" ", 1)[1]) for l in lines
+               if "_bucket{" in l]
+    assert buckets == sorted(buckets)
+    # every sample line parses as "name{labels} value" or "name value"
+    for l in lines:
+        if l.startswith("#"):
+            continue
+        name, value = l.rsplit(" ", 1)
+        float(value)
+
+
+# -- the endpoints ------------------------------------------------------------
+
+def test_service_serves_endpoints_when_port_set(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_METRICS_PORT", "0")  # ephemeral
+    svc = SweepService(start=False)
+    try:
+        srv = export.active_server()
+        assert srv is not None
+        base = f"http://127.0.0.1:{srv.port}"
+
+        job = svc.submit(_scenario(), tenant="tenantA")
+        svc.run_until_idle()
+        assert job.status == "completed"
+
+        # /metrics: Prometheus-parseable, with the per-tenant SLO series
+        status, text = _get(base + "/metrics")
+        assert status == 200
+        assert 'mplc_service_queue_wait_sec_bucket{le=' in text
+        assert 'tenant="tenantA"' in text
+        assert "mplc_service_slice_sec_count" in text
+        assert "mplc_service_jobs_completed 1" in text
+
+        # /varz: full JSON incl. the service job table and histogram
+        # quantiles
+        status, body = _get(base + "/varz")
+        assert status == 200
+        varz = json.loads(body)
+        svc_row = varz[svc._provider_key]
+        assert svc_row["jobs"][job.job_id]["status"] == "completed"
+        assert svc_row["jobs"][job.job_id]["tenant"] == "tenantA"
+        hist = varz["metrics"]["histograms"][
+            "service.queue_wait_sec{tenant=tenantA}"]
+        assert hist["count"] == 1 and hist["p50"] is not None
+
+        # /healthz: healthy while idle
+        status, body = _get(base + "/healthz")
+        assert status == 200
+        health = json.loads(body)
+        assert health["healthy"] is True
+        prov = health["providers"][svc._provider_key]
+        assert prov["journal"] == "disabled"
+        assert prov["worker_alive"] is True
+
+        # unknown route -> 404, index -> 200
+        assert _get(base + "/nope")[0] == 404
+        assert _get(base + "/")[0] == 200
+    finally:
+        svc.shutdown()
+
+
+def test_healthz_flips_on_worker_stall(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_METRICS_PORT", "0")
+    svc = SweepService(start=False)
+    try:
+        base = f"http://127.0.0.1:{export.active_server().port}"
+        # simulate a wedged quantum: a job is "running" and the heartbeat
+        # is older than the stall bound
+        svc._running_job = types.SimpleNamespace(job_id="jobX")
+        svc._heartbeat = time.monotonic() - (sched.STALL_HEALTHY_SEC + 1)
+        status, body = _get(base + "/healthz")
+        assert status == 503
+        health = json.loads(body)
+        assert health["healthy"] is False
+        prov = health["providers"][svc._provider_key]
+        assert prov["stalled"] is True
+        assert prov["running_job"] == "jobX"
+        assert prov["worker_heartbeat_age_sec"] > sched.STALL_HEALTHY_SEC
+        # recovery: a fresh beat with no running job flips back
+        svc._running_job = None
+        svc._heartbeat = time.monotonic()
+        assert _get(base + "/healthz")[0] == 200
+    finally:
+        svc._running_job = None
+        svc.shutdown()
+
+
+def test_no_socket_or_thread_without_the_env(monkeypatch):
+    monkeypatch.delenv("MPLC_TPU_METRICS_PORT", raising=False)
+    assert export.maybe_start_from_env() is None
+    svc = SweepService(start=False)
+    try:
+        assert export.active_server() is None
+    finally:
+        svc.shutdown()
+
+
+def test_malformed_port_warns_and_stays_off(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_METRICS_PORT", "not-a-port")
+    with pytest.warns(UserWarning, match="not a port number"):
+        assert export.maybe_start_from_env() is None
+    assert export.active_server() is None
+
+
+def test_plain_port_binds_loopback_host_port_opts_in(monkeypatch):
+    """The endpoints are unauthenticated: a bare port must bind loopback
+    only, and `host:port` is the explicit wider-exposure opt-in."""
+    monkeypatch.setenv("MPLC_TPU_METRICS_PORT", "0")
+    srv = export.maybe_start_from_env()
+    assert srv.host == "127.0.0.1"
+    export.stop()
+    monkeypatch.setenv("MPLC_TPU_METRICS_PORT", "0.0.0.0:0")
+    srv = export.maybe_start_from_env()
+    assert srv.host == "0.0.0.0"
+    assert _get(f"http://127.0.0.1:{srv.port}/healthz")[0] in (200, 503)
+
+
+def test_broken_provider_degrades_not_500():
+    export.register_health("boom", lambda: 1 / 0)
+    try:
+        healthy, view = export.health_view()
+        assert healthy is False
+        assert "error" in view["providers"]["boom"]
+    finally:
+        export.unregister("boom")
+
+
+# -- per-tenant SLO: the report row -------------------------------------------
+
+def test_report_slo_row_from_service_records():
+    with trace.collect() as recs:
+        svc = SweepService(start=False)
+        try:
+            jobs = [svc.submit(_scenario(seed), tenant=f"t{seed}")
+                    for seed in (0, 1)]
+            svc.run_until_idle()
+            for j in jobs:
+                assert j.status == "completed"
+        finally:
+            svc.shutdown()
+    rep = report.sweep_report(recs)
+    slo = rep["slo"]
+    assert set(slo) == {"t0", "t1"}
+    for tn in ("t0", "t1"):
+        row = slo[tn]
+        assert row["jobs"] == 1
+        assert row["queue_wait_s"]["p50"] is not None
+        assert row["ttfv_s"]["p50"] is not None
+        assert row["slice_s"]["count"] >= 1
+        assert row["slice_s"]["p50"] <= row["slice_s"]["p99"]
+        assert row["deadline_misses"] == 0
+        assert row["retries"] == 0
+    text = report.format_report(rep)
+    assert "slo[t0]" in text and "deadline_misses=0" in text
+    # live histograms observed the same series, labeled by tenant
+    snap = metrics.snapshot()["histograms"]
+    assert snap["service.queue_wait_sec{tenant=t0}"]["count"] == 1
+    assert snap["service.time_to_first_value_sec{tenant=t1}"]["count"] == 1
+
+
+def test_deadline_miss_counted_per_tenant():
+    svc = SweepService(start=False)
+    try:
+        job = svc.submit(_scenario(), tenant="slow", deadline_sec=0.0)
+        time.sleep(0.01)
+        svc.run_until_idle()
+        assert job.status == "cancelled"
+        assert job.deadline_missed is True
+    finally:
+        svc.shutdown()
+    snap = metrics.snapshot()["counters"]
+    assert snap["service.deadline_misses{tenant=slow}"] == 1
+
+
+# -- the crash flight recorder ------------------------------------------------
+
+def test_quarantined_job_writes_postmortem_with_failing_batch_spans(
+        monkeypatch, tmp_path, caplog):
+    """The acceptance path: a job whose batches keep crashing quarantines
+    AND leaves a postmortem file whose ring buffer holds the failing
+    batch's spans; the quarantine log line references the file."""
+    flight_dir = tmp_path / "flight"
+    monkeypatch.setenv("MPLC_TPU_FLIGHT_RECORDER_DIR", str(flight_dir))
+    monkeypatch.setenv("MPLC_TPU_MAX_RETRIES", "1")
+    # attempt 1 crashes at batch 1; the retry's first batch is ordinal 2
+    # (the engine keeps counting) and crashes too, exhausting the budget
+    monkeypatch.setenv("MPLC_TPU_SERVICE_FAULT_PLAN",
+                       "crash@job1:batch1,crash@job1:batch2")
+    svc = SweepService(start=False)
+    try:
+        with trace.collect() as recs:
+            job = svc.submit(_scenario(), tenant="victim")
+            with caplog.at_level(logging.ERROR, logger="mplc_tpu"):
+                svc.run_until_idle()
+        assert job.status == "quarantined"
+        with pytest.raises(JobQuarantined):
+            job.result(timeout=1)
+    finally:
+        svc.shutdown()
+
+    # slo retries mirror the LIVE counter exactly: only the re-queued
+    # attempt counts, not the quarantining final one
+    slo = report.sweep_report(recs)["slo"]["victim"]
+    live = metrics.snapshot()["counters"]["service.job_retries{tenant=victim}"]
+    assert slo["retries"] == live == 1
+
+    dumps = sorted(flight_dir.glob("mplc_flight_job_quarantined_*.json"))
+    assert dumps, "quarantine must write a postmortem flight record"
+    payload = json.loads(dumps[-1].read_text())
+    assert payload["reason"] == "job_quarantined"
+    assert payload["extra"]["job"] == job.job_id
+    assert payload["extra"]["tenant"] == "victim"
+    # the ring holds the failing batch's spans. The ring is
+    # process-global (earlier tests' records may precede), so scope the
+    # assertions to records after THIS job's submit event.
+    ring = payload["ring_records"]
+    submit_idx = max(i for i, r in enumerate(ring)
+                     if r["name"] == "service.submit"
+                     and r["attrs"].get("job") == job.job_id)
+    ours = ring[submit_idx:]
+    names = [r["name"] for r in ours]
+    # both failing attempts' injected faults, at their batch ordinals
+    fault_ordinals = [r["attrs"]["ordinal"] for r in ours
+                      if r["name"] == "engine.fault"]
+    assert fault_ordinals == [1, 2]
+    # and the batch machinery around them
+    assert "engine.dispatch" in names
+    assert "service.job_fault" in names
+    assert payload["metrics"]["counters"]["engine.faults_injected"] >= 2
+    # the quarantine log line references the postmortem path
+    quarantine_logs = [r.message for r in caplog.records
+                       if "quarantining job" in r.message]
+    assert quarantine_logs and str(dumps[-1]) in quarantine_logs[-1]
+    assert metrics.snapshot()["counters"]["obs.flight_dumps"] >= 1
+
+
+def test_journal_corruption_writes_postmortem(monkeypatch, tmp_path):
+    from mplc_tpu.service import JournalCorruptError, SweepJournal
+
+    flight_dir = tmp_path / "flight"
+    monkeypatch.setenv("MPLC_TPU_FLIGHT_RECORDER_DIR", str(flight_dir))
+    path = tmp_path / "wal.jsonl"
+    j = SweepJournal(path)
+    j.append({"type": "submit", "job": "job1"})
+    j.append({"type": "value", "job": "job1", "subset": [0], "value": 0.5})
+    j.close()
+    # corrupt the FIRST record (mid-file, good records after): not a torn
+    # tail -> replay must refuse AND leave a postmortem
+    lines = path.read_bytes().split(b"\n")
+    lines[0] = lines[0][:-6] + b"xxxx}"
+    path.write_bytes(b"\n".join(lines))
+    with pytest.raises(JournalCorruptError, match="postmortem"):
+        SweepJournal.replay(path)
+    assert list(flight_dir.glob("mplc_flight_journal_corrupt_*.json"))
+
+
+def test_flight_dump_never_raises(monkeypatch):
+    # an unwritable directory: dump returns None instead of raising
+    monkeypatch.setenv("MPLC_TPU_FLIGHT_RECORDER_DIR",
+                       "/proc/definitely/not/writable")
+    assert flight.dump("test_reason") is None

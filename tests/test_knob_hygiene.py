@@ -241,6 +241,77 @@ def test_donation_allowlist_is_not_stale_and_has_reasons():
             f"allowlist entry {key} needs a non-empty reason string")
 
 
+# -- span-name hygiene --------------------------------------------------------
+#
+# Trace CONSUMERS (obs/report.py, obs/chrome_trace.py, the projection
+# scripts) dispatch on span-name string literals; an instrumentation
+# rename that skips the consumers silently empties a report row. The
+# static scan below collects every literal name passed to
+# span()/start_span()/event() in the package, bench and scripts, and
+# enforces two-way agreement with the documented registry
+# (obs/trace.py SPAN_REGISTRY).
+
+def _span_call_names():
+    """(relpath, lineno, name_or_None) for every span()/start_span()/
+    event() call site; name is None when the first argument is not a
+    string literal (itself a hygiene violation: tooling can't scan it)."""
+    files = [REPO / "bench.py"]
+    files += sorted((REPO / "mplc_tpu").rglob("*.py"))
+    files += sorted((REPO / "scripts").glob("*.py"))
+    out = []
+    for f in files:
+        rel = f.relative_to(REPO).as_posix()
+        tree = ast.parse(f.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name not in ("span", "start_span", "event") or not node.args:
+                continue
+            first = node.args[0]
+            literal = (first.value
+                       if isinstance(first, ast.Constant)
+                       and isinstance(first.value, str) else None)
+            out.append((rel, node.lineno, literal))
+    return out
+
+
+def test_every_span_name_is_registered():
+    from mplc_tpu.obs.trace import SPAN_REGISTRY
+
+    sites = _span_call_names()
+    assert sites, "the scan found no span()/event() call sites at all"
+    dynamic = [f"{rel}:{ln}" for rel, ln, name in sites if name is None]
+    assert not dynamic, (
+        "span()/event() call sites with a non-literal name: "
+        + ", ".join(dynamic)
+        + " — span names must be string literals so consumer tooling "
+        "(report rows, the Perfetto exporter, this scan) can see them")
+    unregistered = sorted({name for _, _, name in sites
+                           if name not in SPAN_REGISTRY})
+    assert not unregistered, (
+        f"span/event names {unregistered} are emitted but not listed in "
+        "obs.trace.SPAN_REGISTRY — register them (with a one-line "
+        "description) so trace consumers can't silently drift from the "
+        "instrumentation")
+
+
+def test_span_registry_has_no_stale_entries():
+    from mplc_tpu.obs.trace import SPAN_REGISTRY
+
+    emitted = {name for _, _, name in _span_call_names() if name}
+    stale = sorted(set(SPAN_REGISTRY) - emitted)
+    assert not stale, (
+        f"obs.trace.SPAN_REGISTRY lists {stale} but no call site emits "
+        "them — remove the dead entries (or the instrumentation they "
+        "described was renamed without updating the registry)")
+    for name, desc in SPAN_REGISTRY.items():
+        assert isinstance(desc, str) and desc.strip(), (
+            f"SPAN_REGISTRY[{name!r}] needs a non-empty description")
+
+
 def test_synth_noise_refusal_is_non_default_only(tmp_path, monkeypatch):
     """MPLC_TPU_SYNTH_NOISE is always set by bench.main() before the
     replay gate runs, so the gate must allow the bench's own 0.75 default

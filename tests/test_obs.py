@@ -75,10 +75,17 @@ def test_leaked_inner_span_does_not_corrupt_nesting():
 
 
 def test_event_records_external_duration():
+    import time as _time
+    before = _time.time()
     with trace.collect() as recs:
         trace.event("trainer.compile", dur=1.25, fn="unit")
     assert recs[0]["dur"] == 1.25
     assert recs[0]["attrs"] == {"fn": "unit"}
+    # ts marks the interval's START: events are emitted AFTER the
+    # measured work, so ts is backdated by dur (timeline consumers would
+    # otherwise draw the slice one duration too late)
+    assert recs[0]["ts"] <= before - 1.25 + 1.0
+    assert recs[0]["ts"] >= before - 1.25 - 1.0
 
 
 def test_spans_are_thread_safe():
@@ -97,6 +104,64 @@ def test_spans_are_thread_safe():
     for i in range(4):
         # each thread's nesting is private: inner-i parents to outer-i
         assert by_name[f"inner-{i}"]["parent"] == by_name[f"outer-{i}"]["id"]
+
+
+def test_worker_thread_spans_never_parent_to_submitter():
+    """Cross-thread span parentage (the sweep-service shape): a worker
+    thread's spans must NOT link to spans the SUBMITTING thread holds
+    open while the worker runs — `parent` is per-thread nesting, never
+    cross-thread causality. Pinned concurrently: the submitter keeps its
+    span open for the worker's whole lifetime."""
+    worker_done = threading.Event()
+    worker_recs = {}
+
+    def worker():
+        # runs strictly inside the submitter's open "submit" span
+        with trace.span("service.slice", tenant="t0") as outer:
+            with trace.span("engine.dispatch") as inner:
+                pass
+        worker_recs["outer"] = outer
+        worker_recs["inner"] = inner
+        worker_done.set()
+
+    with trace.collect() as recs:
+        with trace.span("submit") as submit_span:
+            t = threading.Thread(target=worker)
+            t.start()
+            assert worker_done.wait(10)
+            t.join()
+    by_id = {r["id"]: r for r in recs}
+    slice_rec = next(r for r in recs if r["name"] == "service.slice")
+    dispatch_rec = next(r for r in recs if r["name"] == "engine.dispatch")
+    # the worker's root span is a ROOT, not a child of the submitter's
+    # open span...
+    assert slice_rec["parent"] is None
+    # ...its own nesting is intact...
+    assert dispatch_rec["parent"] == slice_rec["id"]
+    # ...and no record of the worker thread parents into the submitter's
+    submit_rec = by_id[submit_span.id]
+    assert slice_rec["thread"] != submit_rec["thread"]
+    for r in recs:
+        if r["thread"] != slice_rec["thread"]:
+            continue
+        parent = r.get("parent")
+        if parent is not None:
+            assert by_id[parent]["thread"] == r["thread"]
+
+
+def test_flight_ring_is_always_on_and_bounded():
+    """Every closed span/event lands in the flight-recorder ring even
+    with NO sink or collector active, and the ring is bounded."""
+    ring_before = len(trace.flight_records())
+    with trace.span("engine.evaluate", requested=1):
+        pass
+    trace.event("engine.fault", kind="transient", site="dispatch",
+                ordinal=1)
+    ring = trace.flight_records()
+    assert len(ring) >= min(ring_before + 2, trace._flight_ring.maxlen)
+    names = [r["name"] for r in ring[-2:]]
+    assert names == ["engine.evaluate", "engine.fault"]
+    assert trace._flight_ring.maxlen == 512  # env-unset default
 
 
 # -- JSONL sink --------------------------------------------------------------
@@ -146,8 +211,11 @@ def test_metrics_snapshot_correctness():
     assert snap["counters"]["c"] == 3.5
     assert snap["gauges"]["g"] == 7
     assert snap["gauges"]["hw"] == 10
+    # 0.5 and 1.0 sit exactly on log2 bucket bounds, so the estimates
+    # are exact here
     assert snap["histograms"]["h"] == {
-        "count": 3, "sum": 1.5, "min": 0.0, "max": 1.0, "mean": 0.5}
+        "count": 3, "sum": 1.5, "min": 0.0, "max": 1.0, "mean": 0.5,
+        "p50": 0.5, "p95": 1.0, "p99": 1.0}
     # registry is get-or-create; a name can't silently change type
     with pytest.raises(TypeError):
         metrics.gauge("c")
@@ -156,9 +224,72 @@ def test_metrics_snapshot_correctness():
                                   "histograms": {}}
 
 
+def test_labeled_metrics_are_distinct_series():
+    """counter(name, tenant=...) creates one metric per (name, labels)
+    pair, keyed `name{k=v}` in the snapshot; the unlabeled metric keeps
+    its plain-name key (pre-label snapshot consumers unchanged)."""
+    metrics.counter("svc.jobs").inc()
+    metrics.counter("svc.jobs", tenant="a").inc(2)
+    metrics.counter("svc.jobs", tenant="b").inc(3)
+    # same labels -> same object, regardless of kwarg order games
+    assert metrics.counter("svc.jobs", tenant="a") is \
+        metrics.counter("svc.jobs", tenant="a")
+    snap = metrics.snapshot()["counters"]
+    assert snap["svc.jobs"] == 1
+    assert snap["svc.jobs{tenant=a}"] == 2
+    assert snap["svc.jobs{tenant=b}"] == 3
+    # a labeled name can't silently change type either
+    with pytest.raises(TypeError):
+        metrics.histogram("svc.jobs", tenant="a")
+
+
+def test_histogram_log_bucket_quantiles():
+    """The fixed log2 buckets give p50/p95/p99 within one bucket (2x) of
+    the true quantile, clamped to the observed range."""
+    h = metrics.histogram("lat")
+    for i in range(1, 101):
+        h.observe(i / 100.0)  # 0.01 .. 1.00
+    assert h.quantile(0.50) is not None
+    # true p50 = 0.50; bucket upper bound is the next power of two
+    assert 0.5 <= h.quantile(0.50) <= 1.0
+    assert 0.95 <= h.quantile(0.95) <= 1.0
+    assert h.quantile(0.99) <= 1.0  # clamped to observed max
+    assert h.quantile(0.0) >= 0.01  # clamped to observed min
+    # export_view carries the shared bounds + per-bucket counts summing
+    # to the observation count (plus an overflow bucket)
+    row = [r for r in metrics.export_view() if r["name"] == "lat"][0]
+    assert row["kind"] == "histogram"
+    assert len(row["bucket_counts"]) == len(row["bounds"]) + 1
+    assert sum(row["bucket_counts"]) == 100
+    # empty histogram: quantiles are None, not garbage
+    assert metrics.histogram("empty").quantile(0.5) is None
+
+
 def test_sample_device_memory_never_raises():
     # CPU backends have no memory_stats — must be a silent no-op
     metrics.sample_device_memory()
+
+
+def test_sample_device_memory_counts_failures(monkeypatch):
+    """A FAILING memory sample (dead tunnel, runtime raise) is counted in
+    obs.memory_sample_errors and warned exactly once per process —
+    silently-dead memory telemetry was the old behavior."""
+    import jax
+
+    def boom():
+        raise RuntimeError("tunnel died")
+
+    monkeypatch.setattr(jax, "local_devices", boom)
+    monkeypatch.setattr(metrics, "_mem_sample_warned", False)
+    with pytest.warns(UserWarning, match="sample_device_memory failed"):
+        metrics.sample_device_memory()
+    # second failure: counted, NOT warned again
+    import warnings as _warnings
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        metrics.sample_device_memory()
+    snap = metrics.snapshot()["counters"]
+    assert snap["obs.memory_sample_errors"] == 2
 
 
 # -- compile tracking --------------------------------------------------------
