@@ -16,6 +16,16 @@ Configs (select with BENCH_CONFIG, default "1"):
      packing (the sidecar's service row carries packed-batch counts and
      per-tenant fair-share cost attribution) and journaling cost
      (MPLC_TPU_SERVICE_SLICE / _MAX_PENDING / _FAULT_PLAN apply)
+  7  service load/chaos harness (scripts/load_gen.py): BENCH_JOBS
+     (default 1000) mixed-shape 1-epoch titanic games across 3 priority
+     tiers against one SweepService under seeded chaos injection
+     (default chaos@rate0.05:seed7 unless MPLC_TPU_SERVICE_FAULT_PLAN is
+     set) — reports saturation throughput, per-tier p50/p95/p99 tail
+     latency, fairness vs stride weights, shed/quarantine accounting,
+     and equality-checks the overload invariant (every accepted job
+     terminal, completed tenants bit-identical to solo runs).
+     MPLC_TPU_SERVICE_WORKERS / _SHED_P99_SEC / _MAX_PENDING apply;
+     the first benchmark of the system AS a service under load
 
 Workload notes. The reference (saved_experiments results.csv) trains ONE
 fedavg MNIST model in ~589 s wall-clock at 50 epochs and needs one full
@@ -215,9 +225,11 @@ _WORKLOAD_KNOBS = (
     "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
     "MPLC_TPU_RETRY_BACKOFF_SEC", "MPLC_TPU_SEED_ENSEMBLE",
     # the service knobs reshape the multi-tenant workload (injected
-    # faults, slice granularity, admission bounds)
+    # faults incl. chaos mode, slice granularity, admission bounds,
+    # worker-pool concurrency, priority weighting, shed threshold)
     "MPLC_TPU_SERVICE_FAULT_PLAN", "MPLC_TPU_SERVICE_MAX_PENDING",
-    "MPLC_TPU_SERVICE_SLICE",
+    "MPLC_TPU_SERVICE_PRIORITY_DEFAULT", "MPLC_TPU_SERVICE_SHED_P99_SEC",
+    "MPLC_TPU_SERVICE_SLICE", "MPLC_TPU_SERVICE_WORKERS",
     "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
     "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SVARM_SAMPLES",
     "MPLC_TPU_SYNTH_SCALE")
@@ -825,6 +837,52 @@ def bench_service(epochs, dtype):
           _baseline_seconds(dataset, epochs, tenants * B))
 
 
+def bench_load(epochs, dtype):
+    """Config 7: the service load/chaos harness (scripts/load_gen.py).
+    The timed quantity is the whole load run — submission with
+    retry_after backoff, scheduling across priority tiers, chaos
+    recovery, drain — and the headline artifacts are the sidecar's
+    saturation/per-tier-latency/invariant blocks rather than the bare
+    wall-clock (dtype is irrelevant: the games are 1-epoch titanic
+    logregs; the service plumbing is what saturates)."""
+    import importlib
+
+    scripts_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "scripts")
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    load_gen = importlib.import_module("load_gen")
+
+    jobs = int(os.environ.get("BENCH_JOBS", "1000"))
+    load_epochs = int(os.environ.get("BENCH_LOAD_EPOCHS", "1"))
+    chaos_plan = None
+    if not os.environ.get("MPLC_TPU_SERVICE_FAULT_PLAN"):
+        chaos_plan = "chaos@rate0.05:seed7"
+    print(f"[bench] load harness: {jobs} jobs, chaos="
+          f"{chaos_plan or os.environ.get('MPLC_TPU_SERVICE_FAULT_PLAN')}",
+          file=sys.stderr, flush=True)
+    report = load_gen.run_load(jobs=jobs, epochs=load_epochs,
+                               chaos_plan=chaos_plan, beat=_beat)
+    elapsed = report["wallclock_s"]
+    inv = report["invariant"]
+    sat = report["saturation"]
+    print(f"[bench] load: {inv['accepted']} accepted in {elapsed:.1f} s "
+          f"({sat['completed_jobs_per_s']:.2f} jobs/s, "
+          f"{sat['completed_coalitions_per_s']:.1f} coalitions/s) "
+          f"outcomes={report['outcomes']} invariant_holds={inv['holds']}",
+          file=sys.stderr, flush=True)
+    if not inv["holds"]:
+        print(f"[bench] INVARIANT VIOLATION: stuck={inv['stuck_jobs']} "
+              f"mismatched={inv['mismatched_jobs']}",
+              file=sys.stderr, flush=True)
+    metric = f"service_load_{jobs}jobs_wallclock"
+    _write_telemetry({"metric": metric, "wallclock_s": elapsed,
+                      "devices": _ndev(),
+                      "invariant_holds": inv["holds"],
+                      "load_report": report})
+    _emit(metric, elapsed, 0.0)
+
+
 def _bench_method(dataset_name, n_partners, method, epochs, dtype,
                   corrupted=None, extra_methods=()):
     """Shared driver for the MC/IS/stratified configs: run
@@ -948,8 +1006,10 @@ def main():
                       extra_methods=("Independent scores",))
     elif config == "6":
         bench_service(epochs, dtype)
+    elif config == "7":
+        bench_load(epochs, dtype)
     else:
-        raise SystemExit(f"unknown BENCH_CONFIG={config!r} (use 1-6)")
+        raise SystemExit(f"unknown BENCH_CONFIG={config!r} (use 1-7)")
 
     if _watchdog_fired.is_set():
         # The watchdog declared this run dead and its fallback child owns

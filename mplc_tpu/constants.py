@@ -244,10 +244,37 @@ SVARM_SAMPLES_ENV = "MPLC_TPU_SVARM_SAMPLES"
 #                                  plan, addressed by job submission
 #                                  ordinal (grammar in faults.py):
 #                                  crash@job2:batch3,reject@job4,
-#                                  stall@job1:sec2
+#                                  stall@job1:sec2 — plus the load
+#                                  harness's seeded chaos extension
+#                                  chaos@rate0.05:seed7 (every job
+#                                  independently draws one random
+#                                  crash/transient/stall fault with the
+#                                  given probability; the draw depends
+#                                  only on (seed, ordinal), so chaos
+#                                  runs replay under any worker count)
+#   MPLC_TPU_SERVICE_WORKERS       scheduler worker-thread pool size
+#                                  (1); each worker is pinned to a
+#                                  device slot (index % local devices)
+#                                  and beats its own /healthz heartbeat
+#   MPLC_TPU_SERVICE_PRIORITY_DEFAULT
+#                                  priority tier for submit() calls that
+#                                  pass none (0); higher integers are
+#                                  more important — the scheduler
+#                                  weights quanta by tier+1 and the
+#                                  overload governor defers/sheds the
+#                                  lowest tier first
+#   MPLC_TPU_SERVICE_SHED_P99_SEC  overload governor threshold: when the
+#                                  windowed queue-wait p99 (recent waits
+#                                  + live queued ages) crosses it, the
+#                                  scheduler defers then SHEDS lowest-
+#                                  tier never-started jobs with a
+#                                  classified JobShed. 0/unset = off.
 SERVICE_MAX_PENDING_ENV = "MPLC_TPU_SERVICE_MAX_PENDING"
 SERVICE_SLICE_ENV = "MPLC_TPU_SERVICE_SLICE"
 SERVICE_FAULT_PLAN_ENV = "MPLC_TPU_SERVICE_FAULT_PLAN"
+SERVICE_WORKERS_ENV = "MPLC_TPU_SERVICE_WORKERS"
+SERVICE_PRIORITY_DEFAULT_ENV = "MPLC_TPU_SERVICE_PRIORITY_DEFAULT"
+SERVICE_SHED_P99_ENV = "MPLC_TPU_SERVICE_SHED_P99_SEC"
 
 # Live telemetry plane (mplc_tpu/obs/export.py + flight.py + chrome_trace):
 #   MPLC_TPU_METRICS_PORT          when set, one stdlib HTTP daemon thread
@@ -325,6 +352,13 @@ ENV_KNOBS = {
     "MPLC_TPU_SERVICE_FAULT_PLAN": "workload",
     "MPLC_TPU_SERVICE_MAX_PENDING": "workload",
     "MPLC_TPU_SERVICE_SLICE": "workload",
+    # the overload-robustness knobs reshape the service workload too:
+    # worker count changes concurrency (and the load-harness ceiling),
+    # the default tier reshapes scheduling weights, and the shed
+    # threshold decides which jobs survive an overloaded run at all
+    "MPLC_TPU_SERVICE_WORKERS": "workload",
+    "MPLC_TPU_SERVICE_PRIORITY_DEFAULT": "workload",
+    "MPLC_TPU_SERVICE_SHED_P99_SEC": "workload",
     "MPLC_TPU_PIPELINE_BATCHES": "workload",
     "MPLC_TPU_RETRY_BACKOFF_SEC": "workload",
     "MPLC_TPU_SLOT_MERGE": "workload",

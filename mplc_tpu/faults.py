@@ -411,18 +411,41 @@ def forever_dropped(plan: dict) -> frozenset:
 # injector at job start, so the firing semantics (once per entry, retries
 # keep their ordinal) are exactly `FaultInjector`'s. Malformed entries
 # warn and are skipped — same contract as the other plans.
+#
+# Chaos mode (the load harness's randomized-but-seeded extension):
+#
+#   chaos@rate0.05:seed7   every submitted job independently draws, with
+#                          probability 0.05, ONE random fault — an
+#                          injected crash, a transient, or a scheduler
+#                          stall — from a generator seeded by
+#                          (seed, job ordinal). The draw depends only on
+#                          the ordinal, never on scheduling order or
+#                          thread interleaving, so a chaos run is
+#                          REPLAYABLE: same seed + same submission order
+#                          = same faults, under any worker count.
+#
+# A chaos entry composes with explicit per-job entries (both apply); at
+# most one chaos entry is honored per plan (a duplicate warns and is
+# ignored). Chaos never injects `reject` or `oom`: admission decisions
+# stay deterministic, and an OOM would re-shape the victim's batch caps
+# rather than exercise the retry/stall recovery paths the harness is
+# probing (it remains available as an explicit per-job entry).
 
 _SERVICE_ENTRY_RE = re.compile(
     r"^(crash|oom|transient)@job([0-9]+):batch([0-9]+)$"
     r"|^(reject)@job([0-9]+)$"
-    r"|^(stall)@job([0-9]+):sec([0-9]+(?:\.[0-9]+)?)$")
+    r"|^(stall)@job([0-9]+):sec([0-9]+(?:\.[0-9]+)?)$"
+    r"|^(chaos)@rate([0-9]+(?:\.[0-9]+)?):seed([0-9]+)$")
 
 
 def parse_service_fault_plan(spec: str | None) -> dict:
     """`{job_ordinal: {"batch": {(site, ordinal): [kind, ...]},
-    "reject": bool, "stall_sec": float}}` from the service-plan grammar.
-    Job ordinals are 1-based submission order. Malformed entries warn and
-    are dropped; empty/unset spec is the empty plan."""
+    "reject": bool, "stall_sec": float}}` from the service-plan grammar,
+    plus — when the plan carries a chaos entry — a `"chaos"` key (a
+    string, so it can never collide with the integer job ordinals)
+    holding `{"rate": float, "seed": int}`. Job ordinals are 1-based
+    submission order. Malformed entries warn and are dropped;
+    empty/unset spec is the empty plan."""
     plan: dict = {}
     if not spec:
         return plan
@@ -440,7 +463,8 @@ def parse_service_fault_plan(spec: str | None) -> dict:
             warnings.warn(
                 f"{SERVICE_FAULT_PLAN_ENV}: ignoring malformed entry "
                 f"{entry!r} (expected <crash|oom|transient>@job<J>:batch<B> "
-                "| reject@job<J> | stall@job<J>:sec<F>)", stacklevel=2)
+                "| reject@job<J> | stall@job<J>:sec<F> | "
+                "chaos@rate<F>:seed<N>)", stacklevel=2)
             continue
         if m.group(1):  # batch-boundary kind
             job, ordinal = int(m.group(2)), int(m.group(3))
@@ -459,7 +483,7 @@ def parse_service_fault_plan(spec: str | None) -> dict:
                     "(job ordinals are 1-based)", stacklevel=2)
                 continue
             slot(job)["reject"] = True
-        else:  # stall
+        elif m.group(6):  # stall
             job, sec = int(m.group(7)), float(m.group(8))
             if job < 1:
                 warnings.warn(
@@ -467,7 +491,72 @@ def parse_service_fault_plan(spec: str | None) -> dict:
                     "(job ordinals are 1-based)", stacklevel=2)
                 continue
             slot(job)["stall_sec"] += sec
+        else:  # chaos
+            rate, seed = float(m.group(10)), int(m.group(11))
+            if not 0.0 <= rate <= 1.0:
+                warnings.warn(
+                    f"{SERVICE_FAULT_PLAN_ENV}: ignoring entry {entry!r} "
+                    "(chaos rate must be in [0, 1])", stacklevel=2)
+                continue
+            if "chaos" in plan:
+                warnings.warn(
+                    f"{SERVICE_FAULT_PLAN_ENV}: ignoring duplicate chaos "
+                    f"entry {entry!r} (keeping the first)", stacklevel=2)
+                continue
+            plan["chaos"] = {"rate": rate, "seed": seed}
     return plan
+
+
+# chaos stall draws are short: the point is scheduling jitter (a quantum
+# that takes noticeably longer than its work), not wall-clock burn — a
+# thousand-job harness run at rate 0.05 sleeps ~1-4 s total
+_CHAOS_KINDS = ("crash", "transient", "stall")
+_CHAOS_STALL_RANGE = (0.02, 0.2)
+_CHAOS_MAX_BATCH = 3
+
+
+def chaos_entry(chaos: "dict | None", ordinal: int) -> "dict | None":
+    """The chaos plan's deterministic per-job draw: None (no fault for
+    this submission) or a plan-slot-shaped entry — `{"batch": {...},
+    "reject": False, "stall_sec": s}` — to merge with any explicit entry
+    for the same ordinal. The generator is seeded by (seed, ordinal)
+    alone, so the draw is identical under any worker count, submission
+    interleaving or retry schedule; batch-kind faults target an early
+    batch ordinal (1..3) so they reliably fire even on small games."""
+    if not chaos:
+        return None
+    rate = float(chaos.get("rate", 0.0))
+    if rate <= 0.0:
+        return None
+    import random
+    rng = random.Random((int(chaos.get("seed", 0)) << 24) ^ int(ordinal))
+    if rng.random() >= rate:
+        return None
+    kind = rng.choice(_CHAOS_KINDS)
+    if kind == "stall":
+        lo, hi = _CHAOS_STALL_RANGE
+        return {"batch": {}, "reject": False,
+                "stall_sec": round(rng.uniform(lo, hi), 3)}
+    batch = rng.randint(1, _CHAOS_MAX_BATCH)
+    return {"batch": {("dispatch", batch): [kind]}, "reject": False,
+            "stall_sec": 0.0}
+
+
+def merge_service_entries(*entries) -> "dict | None":
+    """Combine explicit and chaos-drawn plan entries for one job into a
+    fresh slot dict (batch fault lists concatenated per boundary, stall
+    seconds summed, reject OR'd). Returns None when every input is None
+    — the common no-fault case stays allocation-free."""
+    live = [e for e in entries if e]
+    if not live:
+        return None
+    out = {"batch": {}, "reject": False, "stall_sec": 0.0}
+    for e in live:
+        for key, kinds in (e.get("batch") or {}).items():
+            out["batch"].setdefault(key, []).extend(kinds)
+        out["reject"] = out["reject"] or bool(e.get("reject"))
+        out["stall_sec"] += float(e.get("stall_sec") or 0.0)
+    return out
 
 
 def service_fault_plan_from_env() -> dict:

@@ -74,6 +74,14 @@ Per-tenant SLO series (service/scheduler.py, labeled `tenant=...`):
     service.job_retries               counter  failed attempts re-queued
     service.job_attempts              histogram attempts at job terminal
 
+Overload accounting (unlabeled; service/admission.py governor):
+
+    service.jobs_shed                 counter  queued jobs terminated by
+                                               the overload governor
+                                               (classified JobShed —
+                                               separate from rejected /
+                                               cancelled / quarantined)
+
 `snapshot()` exports the whole registry as a plain dict (JSON-ready);
 `reset()` clears it (tests and per-run report boundaries);
 `export_view()` returns structured rows (name, labels, kind, values) for

@@ -388,6 +388,9 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             "completed": by_status.get("completed", 0),
             "quarantined": by_status.get("quarantined", 0),
             "cancelled": by_status.get("cancelled", 0),
+            # overload-governor sheds: a classified outcome of its own,
+            # never folded into cancelled/quarantined
+            "shed": by_status.get("shed", 0),
             "recovered": sum(1 for a in svc_jobs.values()
                              if a.get("recovered")),
             "cross_tenant_packed_batches": sum(
@@ -512,13 +515,16 @@ def format_report(report: dict) -> str:
     if svc is not None:
         # the multi-tenant service view: outcomes + the packing win, then
         # one fair-share line per tenant
-        lines.append(
+        line = (
             f"  service     jobs={svc['jobs']}  "
             f"completed={svc['completed']}  "
             f"quarantined={svc['quarantined']}  "
             f"cancelled={svc['cancelled']}  "
             f"recovered={svc['recovered']}  "
             f"packed_batches={svc['cross_tenant_packed_batches']}")
+        if svc.get("shed"):
+            line += f"  shed={svc['shed']}"
+        lines.append(line)
         for name, t in (svc.get("per_tenant") or {}).items():
             share = t.get("cost_share")
             lines.append(

@@ -99,6 +99,8 @@ SPAN_REGISTRY = {
     "service.reject": "admission refused (backpressure or fault plan)",
     "service.slice": "one scheduling quantum of one job",
     "service.stall": "injected scheduler stall (service fault plan)",
+    "service.shed": "job shed by the overload admission governor "
+                    "(attrs: priority/queue_wait_p99_sec/retry_after_sec)",
     "service.job": "terminal job event (attrs incl. SLO: queue_wait_sec/"
                    "ttfv_sec/deadline_missed)",
     "service.job_fault": "one failed job attempt (pre retry/quarantine)",

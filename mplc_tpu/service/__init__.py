@@ -1,18 +1,22 @@
 """Sweep-as-a-service: fault-isolated multi-tenant sweep scheduling with
-journaled crash recovery (scheduler.py), cross-tenant program packing
-bookkeeping (packer.py) and the checksummed write-ahead journal
-(journal.py)."""
+a device-pinned worker pool and journaled crash recovery (scheduler.py),
+SLO-driven admission control, priority tiers and load shedding
+(admission.py), cross-tenant program packing bookkeeping (packer.py) and
+the checksummed write-ahead journal (journal.py)."""
 
+from .admission import AdmissionController, TierQueue
 from .journal import JournalCorruptError, SweepJournal
 from .packer import CrossTenantPacker
-from .scheduler import (JobCancelled, JobQuarantined, ServiceClosed,
-                        ServiceError, ServiceOverloaded, ServiceRejected,
-                        SweepJob, SweepService)
+from .scheduler import (JobCancelled, JobQuarantined, JobShed,
+                        ServiceClosed, ServiceError, ServiceOverloaded,
+                        ServiceRejected, SweepJob, SweepService)
 
 __all__ = [
+    "AdmissionController",
     "CrossTenantPacker",
     "JobCancelled",
     "JobQuarantined",
+    "JobShed",
     "JournalCorruptError",
     "ServiceClosed",
     "ServiceError",
@@ -21,4 +25,5 @@ __all__ = [
     "SweepJob",
     "SweepJournal",
     "SweepService",
+    "TierQueue",
 ]

@@ -41,6 +41,7 @@ import hashlib
 import json
 import logging
 import os
+import threading
 import warnings
 
 logger = logging.getLogger("mplc_tpu")
@@ -58,11 +59,15 @@ def _checksum(rec: dict) -> str:
 
 
 class SweepJournal:
-    """Append-only, checksummed, fsync'd journal (one writer at a time)."""
+    """Append-only, checksummed, fsync'd journal. Appends are serialized
+    by an internal lock: the service's worker POOL journals harvested
+    values from several threads at once, and two interleaved writes to
+    one append handle would tear both records."""
 
     def __init__(self, path):
         self.path = str(path)
         self._fh = None
+        self._lock = threading.Lock()
 
     def _handle(self):
         if self._fh is None:
@@ -87,17 +92,19 @@ class SweepJournal:
         harvested coalition of a batch at once."""
         if not recs:
             return
-        fh = self._handle()
-        for rec in recs:
-            fh.write(json.dumps(
-                {"sha256": _checksum(rec), "rec": rec}).encode() + b"\n")
-        fh.flush()
-        os.fsync(fh.fileno())
+        with self._lock:
+            fh = self._handle()
+            for rec in recs:
+                fh.write(json.dumps(
+                    {"sha256": _checksum(rec), "rec": rec}).encode() + b"\n")
+            fh.flush()
+            os.fsync(fh.fileno())
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     # -- recovery --------------------------------------------------------
 

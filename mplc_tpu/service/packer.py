@@ -22,13 +22,15 @@ the second tenant compiled nothing new).
 
 from __future__ import annotations
 
+import threading
+
 from ..contrib.bank import ProgramBank
 
 
 class CrossTenantPacker:
     """Tracks program-key -> tenants served, across every job the service
-    has scheduled. Thread-compatible with the scheduler's single worker
-    (all calls happen on the scheduling thread)."""
+    has scheduled. The ownership map is lock-guarded: the scheduler's
+    worker POOL observes plans from several threads at once."""
 
     def __init__(self):
         # program key -> set of tenant names whose buckets rode it.
@@ -36,6 +38,7 @@ class CrossTenantPacker:
         # distinct (shape, slots, width) program — the same space the
         # global bank FIFO-bounds), never by job count.
         self._owners: dict = {}
+        self._lock = threading.Lock()
 
     @staticmethod
     def _keyer(engine) -> ProgramBank:
@@ -63,18 +66,20 @@ class CrossTenantPacker:
         engine dispatches for it is cross-tenant packed."""
         keyer = self._keyer(engine)
         packed: dict = {}
-        for pipe, slot_count, width in plan:
-            key = keyer.program_key(pipe, slot_count, width)
-            owners = self._owners.setdefault(key, set())
-            shared = bool(owners - {tenant})
-            # a slice can hold several None-slot buckets (singles + the
-            # masked multi path); flag the slot_count packed if ANY of
-            # its buckets is shared
-            packed[slot_count] = packed.get(slot_count, False) or shared
-            owners.add(tenant)
+        with self._lock:
+            for pipe, slot_count, width in plan:
+                key = keyer.program_key(pipe, slot_count, width)
+                owners = self._owners.setdefault(key, set())
+                shared = bool(owners - {tenant})
+                # a slice can hold several None-slot buckets (singles +
+                # the masked multi path); flag the slot_count packed if
+                # ANY of its buckets is shared
+                packed[slot_count] = packed.get(slot_count, False) or shared
+                owners.add(tenant)
         return packed
 
     def tenants_for(self, engine, pipe, slot_count, width) -> set:
         """The tenants whose buckets have ridden this program (tests)."""
         key = self._keyer(engine).program_key(pipe, slot_count, width)
-        return set(self._owners.get(key, ()))
+        with self._lock:
+            return set(self._owners.get(key, ()))

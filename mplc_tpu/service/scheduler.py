@@ -3,10 +3,15 @@
 A `SweepService` is a long-lived process-level engine front end: tenants
 submit `Scenario` + method jobs onto a bounded queue and get a `SweepJob`
 handle that streams per-coalition values back incrementally and resolves
-to the method's contributivity scores. One worker thread round-robins a
-scheduling quantum ("slice") across active jobs, so many concurrent
+to the method's contributivity scores. A pool of
+`MPLC_TPU_SERVICE_WORKERS` worker threads (default 1) round-robins a
+scheduling quantum ("slice") across active jobs — each worker pinned to
+a device slot (`worker index % local device count`; uncommitted
+computation inside its quanta defaults onto that device when the host
+has more than one) and beating its OWN heartbeat, so many concurrent
 contributivity games share one process, one device pool and one program
-bank without any tenant monopolizing the device.
+bank without any tenant monopolizing the device, and one wedged worker
+flips only its own liveness on /healthz.
 
 The headline is the fault model, not the queue:
 
@@ -29,16 +34,32 @@ The headline is the fault model, not the queue:
   attempts instead of retrying forever. Permanent failures (a classified
   `LadderExhaustedError`, a genuine bug) quarantine immediately.
 
-  **Admission control and deadlines.** The queue is bounded
+  **Admission control, priorities and deadlines.** The queue is bounded
   (`MPLC_TPU_SERVICE_MAX_PENDING`): past the bound, `submit` raises
-  `ServiceOverloaded` — a clean, synchronous backpressure signal, never a
-  silent drop. A per-job `deadline_sec` is enforced cooperatively at
-  every batch boundary (the engine's progress hook) and every quantum
-  boundary: an expired job raises `JobCancelled` between batches — no
-  in-flight dispatch is abandoned mid-device — its engine is dropped (the
-  only references to its device buffers), and the cancellation is
-  journaled. `shutdown(drain=True)` stops admissions and completes every
-  queued job before returning.
+  `ServiceOverloaded` — a clean, synchronous backpressure signal
+  carrying a `retry_after_sec` hint (the live queue-wait p50; 0.0 with
+  no history), never a silent drop. Jobs carry an integer priority tier
+  (`submit(..., priority=)`, default
+  `MPLC_TPU_SERVICE_PRIORITY_DEFAULT`; higher = more important): the run
+  queue (service/admission.py `TierQueue`) weights scheduling quanta by
+  `tier + 1` via stride scheduling, round-robin FIFO within a tier. On
+  top sits the SLO-driven overload governor (`AdmissionController`):
+  when the queue-wait p99 — over a sliding window of recent waits plus
+  the live ages of everything still queued — crosses
+  `MPLC_TPU_SERVICE_SHED_P99_SEC` (0/unset = governor off), the
+  scheduler first DEFERS the lowest queued tier, then SHEDS its newest
+  never-started jobs with a classified, journaled `JobShed` (counted in
+  `service.jobs_shed`, separate from rejected/cancelled/quarantined,
+  and also carrying `retry_after_sec`). A per-job `deadline_sec` is
+  enforced cooperatively at every batch boundary (the engine's progress
+  hook) and every quantum boundary: an expired job raises `JobCancelled`
+  between batches — no in-flight dispatch is abandoned mid-device — its
+  engine is dropped (the only references to its device buffers), and the
+  cancellation is journaled; a deadline that expires while the job is
+  STILL QUEUED cancels before any work and records no queue-wait/ttfv
+  SLO sample (an expired wait is not a latency datum). `shutdown(
+  drain=True)` stops admissions and completes every queued job before
+  returning.
 
   **Journaled crash recovery.** When constructed with a `journal_path`,
   every accepted submission and every harvested `(tenant, subset, value)`
@@ -70,6 +91,12 @@ admission refuse the 4th submission, `stall@job1:sec2` sleeps the
 scheduler before job 1's next quantum (billed against job 1's own
 deadline; with a single shared device, a stalled tenant's compute slot is
 indistinguishable from slow compute for whoever is behind it in line).
+A `chaos@rate0.05:seed7` entry extends the plan with randomized-but-
+replayable injection: every submission independently draws (seeded by
+plan seed x job ordinal, so the draw survives any worker interleaving)
+one crash/transient/stall fault with the given probability — the load
+harness's (scripts/load_gen.py) way of proving the isolation and
+accounting machinery holds at thousands of jobs.
 """
 
 from __future__ import annotations
@@ -87,6 +114,7 @@ from ..obs import export as obs_export
 from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
+from .admission import AdmissionController, TierQueue
 from .journal import SweepJournal
 from .packer import CrossTenantPacker
 
@@ -112,11 +140,35 @@ class ServiceClosed(ServiceError):
 
 class ServiceOverloaded(ServiceError):
     """Backpressure: the bounded submission queue is full. Resubmit after
-    draining — nothing about the request itself is wrong."""
+    draining — nothing about the request itself is wrong.
+
+    `retry_after_sec` is the live backoff hint: the service's windowed
+    queue-wait p50 (roughly one queue's worth of patience), or 0.0 when
+    no job has ever been scheduled. Callers should sleep about that long
+    before resubmitting instead of hammering `submit` in a tight loop —
+    the load harness (scripts/load_gen.py) does exactly that."""
+
+    def __init__(self, msg: str, retry_after_sec: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_sec = float(retry_after_sec)
 
 
 class ServiceRejected(ServiceError):
     """Admission control refused the job (fault-plan injected reject)."""
+
+
+class JobShed(ServiceError):
+    """The overload governor terminated this still-queued job to protect
+    the queue-wait SLO of higher-priority work (service/admission.py).
+    A classified, journaled outcome — never a silent drop: the job's
+    status is `"shed"`, it is counted in `service.jobs_shed` (separate
+    from rejected/cancelled/quarantined), and `retry_after_sec` carries
+    the same live backoff hint as `ServiceOverloaded`. Nothing about the
+    request itself is wrong; resubmit later (or at a higher priority)."""
+
+    def __init__(self, msg: str, retry_after_sec: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_sec = float(retry_after_sec)
 
 
 class JobQuarantined(ServiceError):
@@ -137,7 +189,7 @@ class SweepJob:
     `result()` blocks for the final contributivity scores."""
 
     def __init__(self, service, job_id, tenant, scenario, method,
-                 deadline_sec, ordinal):
+                 deadline_sec, ordinal, priority=0):
         self.service = service
         self.job_id = job_id
         self.tenant = tenant
@@ -145,6 +197,11 @@ class SweepJob:
         self.method = method
         self.deadline_sec = deadline_sec
         self.ordinal = ordinal  # 1-based submission ordinal (fault plan)
+        self.priority = int(priority)  # tier: higher = more important
+        # the job's resolved service-fault entry (explicit plan merged
+        # with the chaos draw), snapshotted at submit so consumption
+        # (stall fires once) is per-job state, never shared plan state
+        self._fault_entry: "dict | None" = None
         self.status = "queued"
         self.engine = None
         self.subsets = None
@@ -247,18 +304,53 @@ class SweepJob:
                 and time.monotonic() - self.submitted_at > self.deadline_sec)
 
 
+class _WorkerSlot:
+    """One pool worker's scheduler-visible state: its thread, its own
+    heartbeat (beaten at quantum starts and batch boundaries — a wedged
+    worker flips only ITS liveness on /healthz), the job it is currently
+    running, and the device slot it is pinned to."""
+
+    __slots__ = ("index", "thread", "heartbeat", "running_job",
+                 "device_slot", "device")
+
+    def __init__(self, index: int, device_slot: int = 0, device=None):
+        self.index = index
+        self.thread = None
+        self.heartbeat = time.monotonic()
+        self.running_job = None
+        self.device_slot = device_slot
+        self.device = device
+
+    def view(self, now: float, stall_sec: float) -> dict:
+        age = now - self.heartbeat
+        running = self.running_job
+        alive = self.thread is None or self.thread.is_alive()
+        return {
+            "worker": self.index,
+            "alive": alive,
+            "heartbeat_age_sec": age,
+            "running_job": running.job_id if running is not None else None,
+            "stalled": running is not None and age > stall_sec,
+            "device_slot": self.device_slot,
+        }
+
+
 class SweepService:
     """The long-lived multi-tenant sweep scheduler (module docstring)."""
 
     def __init__(self, journal_path=None, max_pending: "int | None" = None,
-                 slice_coalitions: "int | None" = None, start: bool = True):
+                 slice_coalitions: "int | None" = None, start: bool = True,
+                 workers: "int | None" = None,
+                 shed_p99_sec: "float | None" = None):
         self._lock = threading.Condition()
-        self._queue: deque = deque()
+        self._queue = TierQueue()
         self._jobs: dict = {}
         self._ordinal = 0
         self._closed = False
         self._running_job = None
         self._worker = None
+        self._workers: list = []
+        self._tl = threading.local()  # .worker: the slot running a quantum
         self._packer = CrossTenantPacker()
         self._plan = faults.service_fault_plan_from_env()
         self._max_pending = (max_pending if max_pending is not None
@@ -267,6 +359,15 @@ class SweepService:
         self._slice = (slice_coalitions if slice_coalitions is not None
                        else constants._env_positive_int(
                            constants.SERVICE_SLICE_ENV, 16))
+        self._n_workers = (workers if workers is not None
+                           else constants._env_positive_int(
+                               constants.SERVICE_WORKERS_ENV, 1))
+        self._priority_default = constants._env_nonneg_int(
+            constants.SERVICE_PRIORITY_DEFAULT_ENV, 0)
+        self._admission = AdmissionController(
+            shed_p99_sec if shed_p99_sec is not None
+            else constants._env_nonneg_float(
+                constants.SERVICE_SHED_P99_ENV, 0.0))
         self._max_job_retries = constants._env_positive_int(
             constants.MAX_RETRIES_ENV, 3)
         self._heartbeat = time.monotonic()
@@ -294,6 +395,10 @@ class SweepService:
         # caller's own handle keeps an evicted job alive)
         self._terminal_order: deque = deque()
         self._max_terminal_jobs = 256
+        # lifetime terminal count: the _jobs map is FIFO-bounded, so
+        # counting done entries there would cap /varz's scalar at the
+        # retention bound instead of the true total
+        self._terminal_seen = 0
         self._recovered: dict = {}
         if journal_path is not None:
             records, _torn = SweepJournal.replay(journal_path)
@@ -302,10 +407,35 @@ class SweepService:
             self._journal = SweepJournal(journal_path)
 
         if start:
-            self._worker = threading.Thread(
-                target=self._worker_loop, daemon=True,
-                name="mplc-sweep-service")
-            self._worker.start()
+            self._start_workers()
+
+    def _start_workers(self) -> None:
+        """Spin up the worker pool: `MPLC_TPU_SERVICE_WORKERS` threads,
+        each pinned to a device slot (`index % local device count`) and
+        carrying its own heartbeat. Device pinning is best-effort: with
+        one local device (or no importable jax) every slot is slot 0 and
+        no placement context is applied."""
+        n_dev = 1
+        devices = None
+        try:
+            import jax
+            devices = jax.local_devices()
+            n_dev = max(len(devices), 1)
+        except Exception:  # pragma: no cover - lean process without jax
+            pass
+        for i in range(self._n_workers):
+            w = _WorkerSlot(
+                index=i, device_slot=i % n_dev,
+                device=(devices[i % n_dev]
+                        if devices is not None and n_dev > 1 else None))
+            w.thread = threading.Thread(
+                target=self._worker_loop, args=(w,), daemon=True,
+                name=f"mplc-sweep-service-{i}")
+            self._workers.append(w)
+            w.thread.start()
+        # back-compat alias: PR-9 callers (and shutdown's join loop)
+        # treated `_worker` as "the threaded mode is on"
+        self._worker = self._workers[0].thread if self._workers else None
 
     # -- recovery --------------------------------------------------------
 
@@ -315,7 +445,7 @@ class SweepService:
         if kind == "submit":
             slot = self._recovered.setdefault(
                 job, {"values": {}, "done": False, "quarantined": False,
-                      "cancelled": False})
+                      "cancelled": False, "shed": False})
             # a resubmission after a previous restart re-journals the
             # submit record: MERGE (keep already-replayed values)
             slot.update(tenant=rec.get("tenant"), method=rec.get("method"),
@@ -329,58 +459,120 @@ class SweepService:
             self._recovered[job]["quarantined"] = True
         elif kind == "cancel" and job in self._recovered:
             self._recovered[job]["cancelled"] = True
+        elif kind == "shed" and job in self._recovered:
+            self._recovered[job]["shed"] = True
 
     # -- live telemetry providers ---------------------------------------
 
     def health_view(self) -> dict:
-        """The /healthz provider: worker liveness, heartbeat age, queue
-        depth and journal status. `healthy` flips False when the worker
-        thread died, or when a job is running and the heartbeat (beaten
-        at quantum starts and batch boundaries) is staler than
-        STALL_HEALTHY_SEC — a wedged quantum, an injected stall, a hung
-        device. An idle service is healthy at any age."""
+        """The /healthz provider: per-worker liveness/heartbeat ages,
+        admission-governor state, queue depth and journal status.
+
+        Each worker beats its OWN heartbeat at quantum starts and batch
+        boundaries, so one wedged worker flips only its own `stalled`
+        flag in the `workers` block. The service-level `healthy` flips
+        False when any worker thread DIED, or when every slot currently
+        running a job is stalled past STALL_HEALTHY_SEC (a single-worker
+        service therefore keeps the PR-10 behavior: its only quantum
+        wedging = unhealthy; in a pool, siblings still making progress
+        keep the service up while the `workers` block names the wedged
+        one). An idle service is healthy at any heartbeat age. The
+        `admission` block surfaces overload BEFORE it becomes a 503:
+        governor state (healthy|deferring|shedding), the live queue-wait
+        p99 vs the shed threshold, and shed/reject accounting."""
         now = time.monotonic()
-        age = now - self._heartbeat
         with self._lock:
             running = self._running_job
             queue_depth = len(self._queue)
             pending = sum(1 for j in self._jobs.values() if not j.done)
             closed = self._closed
-        worker_alive = self._worker is None or self._worker.is_alive()
-        stalled = running is not None and age > STALL_HEALTHY_SEC
+            workers = [w.view(now, STALL_HEALTHY_SEC)
+                       for w in self._workers]
+            queued_ages = [now - j.submitted_at
+                           for j in self._queue.jobs()]
+            admission = self._admission.view(queued_ages)
+        # the inline slot (start=False / step() mode) keeps the PR-9
+        # single-heartbeat semantics; it only matters when a quantum is
+        # actually running there
+        inline_age = now - self._heartbeat
+        slots = list(workers)
+        if not workers or running is not None:
+            slots.append({
+                "worker": "inline", "alive": True,
+                "heartbeat_age_sec": inline_age,
+                "running_job": (running.job_id
+                                if running is not None else None),
+                "stalled": (running is not None
+                            and inline_age > STALL_HEALTHY_SEC),
+                "device_slot": 0,
+            })
+        worker_alive = all(w["alive"] for w in workers) if workers else True
+        busy = [s for s in slots if s["running_job"] is not None]
+        stalled_busy = [s for s in busy if s["stalled"]]
+        stalled = bool(stalled_busy)
+        all_wedged = bool(busy) and len(stalled_busy) == len(busy)
+        running_names = [s["running_job"] for s in busy]
         return {
-            "healthy": worker_alive and not stalled,
+            "healthy": worker_alive and not all_wedged,
             "worker_alive": worker_alive,
-            "worker_heartbeat_age_sec": age,
+            "workers": slots,
+            "worker_heartbeat_age_sec": min(
+                (s["heartbeat_age_sec"] for s in slots), default=inline_age),
             "stalled": stalled,
-            "running_job": running.job_id if running is not None else None,
+            "running_job": running_names[0] if running_names else None,
+            "running_jobs": running_names,
             "queue_depth": queue_depth,
             "jobs_pending": pending,
             "closed": closed,
+            "admission": admission,
             "journal": ("disabled" if self._journal is None
                         else "broken" if self._journal_broken else "ok"),
         }
 
+    # /varz job-table bound: every non-terminal job is always listed, but
+    # only this many of the MOST RECENT terminal jobs — a load-generator
+    # run submitting thousands of jobs must not balloon the endpoint
+    # response (the full terminal count is retained as a scalar)
+    VARZ_TERMINAL_JOBS = 100
+
     def varz_view(self) -> dict:
-        """The /varz provider: the full engine-state snapshot — per-job
-        status table plus the scheduler's admission/queue knobs."""
+        """The /varz provider: the engine-state snapshot — a per-job
+        status table (all live jobs + the `VARZ_TERMINAL_JOBS` most
+        recent terminal ones; `jobs_total` / `terminal_jobs_total` keep
+        the full counts) plus the scheduler's admission/queue knobs."""
         with self._lock:
+            recent_terminal = set(
+                list(self._terminal_order)[-self.VARZ_TERMINAL_JOBS:])
             jobs = {
                 job_id: {
                     "tenant": j.tenant, "method": j.method,
                     "status": j.status, "attempts": j.attempts,
-                    "ordinal": j.ordinal,
+                    "ordinal": j.ordinal, "priority": j.priority,
                     "values_streamed": len(j._stream),
                     "packed_batches": j.packed_batches,
                     "recovered_values": j.recovered_values,
                     "deadline_sec": j.deadline_sec,
                     "age_sec": time.monotonic() - j.submitted_at,
-                } for job_id, j in self._jobs.items()}
+                } for job_id, j in self._jobs.items()
+                if not j.done or job_id in recent_terminal}
+            listed_terminal = sum(1 for row in jobs.values()
+                                  if row["status"] not in ("queued",
+                                                           "running"))
             return {
                 "jobs": jobs,
+                # lifetime totals (the _jobs map itself is FIFO-bounded
+                # at 256 terminals, so these come from the monotone
+                # counter, not a scan of what happens to be retained)
+                "jobs_total": self._terminal_seen + sum(
+                    1 for j in self._jobs.values() if not j.done),
+                "terminal_jobs_total": self._terminal_seen,
+                "terminal_jobs_truncated": max(
+                    self._terminal_seen - listed_terminal, 0),
                 "queue_depth": len(self._queue),
                 "max_pending": self._max_pending,
+                "workers": self._n_workers,
                 "slice_coalitions": self._slice,
+                "admission": self._admission.view(),
                 "closed": self._closed,
                 "recovered_jobs": len(self._recovered),
             }
@@ -394,7 +586,8 @@ class SweepService:
         return [{"job_id": jid, "tenant": r.get("tenant"),
                  "method": r.get("method"), "values": len(r["values"]),
                  "done": r["done"], "quarantined": r["quarantined"],
-                 "cancelled": r["cancelled"]}
+                 "cancelled": r["cancelled"],
+                 "shed": r.get("shed", False)}
                 for jid, r in self._recovered.items()]
 
     # -- submission ------------------------------------------------------
@@ -402,14 +595,20 @@ class SweepService:
     def submit(self, scenario, method: str = "Shapley values",
                tenant: str = "tenant0",
                deadline_sec: "float | None" = None,
-               job_id: "str | None" = None) -> SweepJob:
+               job_id: "str | None" = None,
+               priority: "int | None" = None) -> SweepJob:
         """Accept a Scenario+method job onto the bounded queue.
+
+        `priority` is the job's integer tier (default
+        `MPLC_TPU_SERVICE_PRIORITY_DEFAULT`, 0; higher = more
+        important): the scheduler weights quanta by `tier + 1` and the
+        overload governor defers/sheds the lowest tier first.
 
         Raises `ServiceClosed` after shutdown, `ServiceOverloaded` when
         the queue is at `MPLC_TPU_SERVICE_MAX_PENDING` (backpressure —
-        resubmit later), `ServiceRejected` on a fault-plan injected
-        admission reject. The accepted submission is journaled before
-        this returns."""
+        its `retry_after_sec` is the live queue-wait p50 backoff hint),
+        `ServiceRejected` on a fault-plan injected admission reject. The
+        accepted submission is journaled before this returns."""
         if method not in constants.CONTRIBUTIVITY_METHODS:
             # validated synchronously: the dispatcher would only log a
             # warning for an unknown name, and a job that "completes"
@@ -417,14 +616,25 @@ class SweepService:
             raise ValueError(
                 f"unknown contributivity method {method!r} (expected one "
                 f"of {constants.CONTRIBUTIVITY_METHODS})")
+        if priority is None:
+            priority = self._priority_default
+        elif int(priority) < 0:
+            raise ValueError(
+                f"priority must be a non-negative tier, got {priority!r}")
         with self._lock:
             if self._closed:
                 raise ServiceClosed("service is shut down")
             self._ordinal += 1
             ordinal = self._ordinal
-            entry = self._plan.get(ordinal)
+            # the job's resolved fault entry: the explicit per-ordinal
+            # plan entry merged with the chaos draw (both deterministic
+            # in the submission ordinal)
+            entry = faults.merge_service_entries(
+                self._plan.get(ordinal),
+                faults.chaos_entry(self._plan.get("chaos"), ordinal))
             if entry is not None and entry.get("reject"):
                 obs_metrics.counter("service.jobs_rejected").inc()
+                self._admission.note_reject()
                 obs_trace.event("service.reject", tenant=tenant,
                                 ordinal=ordinal, reason="fault_plan")
                 raise ServiceRejected(
@@ -433,19 +643,24 @@ class SweepService:
             pending = sum(1 for j in self._jobs.values() if not j.done)
             if pending >= self._max_pending:
                 obs_metrics.counter("service.jobs_rejected").inc()
+                self._admission.note_reject()
                 obs_trace.event("service.reject", tenant=tenant,
                                 ordinal=ordinal, reason="backpressure")
+                hint = self._admission.retry_after_sec()
                 raise ServiceOverloaded(
                     f"submission queue is full ({pending} pending >= "
                     f"{constants.SERVICE_MAX_PENDING_ENV}="
-                    f"{self._max_pending}); resubmit after jobs drain")
+                    f"{self._max_pending}); resubmit after jobs drain "
+                    f"(retry_after_sec={hint:.3f})",
+                    retry_after_sec=hint)
             if job_id is None:
                 job_id = f"job{ordinal}"
             if job_id in self._jobs:
                 raise ValueError(f"job id {job_id!r} already submitted "
                                  "to this service")
             job = SweepJob(self, job_id, tenant, scenario, method,
-                           deadline_sec, ordinal)
+                           deadline_sec, ordinal, priority=priority)
+            job._fault_entry = entry
             if self._journal is not None:
                 # journal BEFORE registering: an un-journalable
                 # submission must fail synchronously (the caller is owed
@@ -460,7 +675,7 @@ class SweepService:
                 try:
                     self._journal.append({
                         "type": "submit", "job": job_id, "tenant": tenant,
-                        "method": method,
+                        "method": method, "priority": int(priority),
                         "partners_count": int(scenario.partners_count)})
                 except OSError as e:
                     raise ServiceError(
@@ -469,48 +684,119 @@ class SweepService:
             self._jobs[job_id] = job
             obs_metrics.counter("service.jobs_accepted").inc()
             obs_trace.event("service.submit", tenant=tenant, job=job_id,
-                            method=method, ordinal=ordinal)
-            self._queue.append(job)
+                            method=method, ordinal=ordinal,
+                            priority=int(priority))
+            self._queue.push(job)
             self._lock.notify_all()
         return job
 
     # -- scheduling loop -------------------------------------------------
 
-    def _worker_loop(self) -> None:
+    def _pick_locked(self) -> tuple:
+        """One admission decision + queue pop, caller holding the lock:
+        evaluate the overload governor on the live queue-wait signal,
+        REMOVE any shed victims from the queue (their terminal
+        bookkeeping — journal fsync, metrics, events — happens in
+        `_shed_job` AFTER the caller releases the lock: shedding exists
+        to recover latency, so it must not stall every worker and
+        submit() behind per-victim fsyncs), then pop the next job
+        (lowest tier deferred while the governor is unhealthy). Returns
+        `(victims, job)`; job is None when the queue is empty."""
+        if not len(self._queue):
+            return [], None
+        now = time.monotonic()
+        state = self._admission.evaluate(
+            [now - j.submitted_at for j in self._queue.jobs()])
+        victims = []
+        if state == AdmissionController.SHEDDING:
+            victims = self._queue.shed_candidates(
+                self._admission.shed_quota(len(self._queue),
+                                           self._max_pending))
+            self._admission.note_shed(len(victims))
+        return victims, self._queue.pop(
+            defer_lowest=state != AdmissionController.HEALTHY)
+
+    def _shed_job(self, job: SweepJob) -> None:
+        """One victim's classified, journaled `JobShed` terminal —
+        never a silent drop. Runs WITHOUT the scheduler lock held."""
+        hint = self._admission.retry_after_sec()
+        p99 = self._admission._last_p99
+        obs_trace.event("service.shed", tenant=job.tenant,
+                        job=job.job_id, priority=job.priority,
+                        queue_wait_p99_sec=p99,
+                        retry_after_sec=hint)
+        logger.warning(
+            "service: SHED job %s (tier %d) — queue-wait p99 %.2fs "
+            "over %s=%.2fs; retry after ~%.2fs", job.job_id,
+            job.priority, p99 if p99 is not None else float("nan"),
+            constants.SERVICE_SHED_P99_ENV,
+            self._admission.shed_p99_sec, hint)
+        self._terminal(job, "shed", JobShed(
+            f"job {job.job_id} shed by overload admission control "
+            f"(queue-wait p99 {p99:.3f}s > "
+            f"{constants.SERVICE_SHED_P99_ENV}="
+            f"{self._admission.shed_p99_sec}s); resubmit in "
+            f"~{hint:.3f}s or at a higher priority",
+            retry_after_sec=hint))
+
+    def _shed_all(self, victims) -> None:
+        if not victims:
+            return
+        for job in victims:
+            self._shed_job(job)
+        # terminal states changed outside the lock: wake drain()/waiters
+        with self._lock:
+            self._lock.notify_all()
+
+    def _worker_loop(self, worker: "_WorkerSlot") -> None:
         while True:
             with self._lock:
-                while not self._queue and not self._closed:
+                victims, job = self._pick_locked()
+                while job is None and not victims and not self._closed:
                     self._lock.wait()
-                if not self._queue:
+                    victims, job = self._pick_locked()
+                if job is not None:
+                    worker.running_job = job
+            self._shed_all(victims)
+            if job is None:
+                if self._closed:
                     return  # closed and drained
-                job = self._queue.popleft()
-                self._running_job = job
+                continue  # everything poppable was shed; re-check
             alive = False
             try:
-                alive = self._run_quantum(job)
+                alive = self._run_quantum(job, worker=worker)
             finally:
                 # clear running AND re-queue under ONE lock hold: a
                 # drain() between the two would otherwise see an idle
                 # service with a live job in neither place
                 with self._lock:
-                    self._running_job = None
+                    worker.running_job = None
                     if alive and not job.done:
-                        self._queue.append(job)  # round-robin re-queue
+                        self._queue.push(job)  # round-robin re-queue
                     self._lock.notify_all()
 
     def step(self) -> bool:
         """Process ONE scheduling quantum inline (start=False mode — the
-        deterministic harness the crash-recovery tests drive). Returns
-        True while work remains."""
+        deterministic harness the crash-recovery and chaos-smoke tests
+        drive). Returns True while work remains."""
         with self._lock:
-            if not self._queue:
-                return False
-            job = self._queue.popleft()
-        alive = self._run_quantum(job)
+            victims, job = self._pick_locked()
+            if job is not None:
+                self._running_job = job
+        self._shed_all(victims)
+        if job is None:
+            with self._lock:
+                return bool(len(self._queue))
+        alive = False
+        try:
+            alive = self._run_quantum(job)
+        finally:
+            with self._lock:
+                self._running_job = None
+                if alive and not job.done:
+                    self._queue.push(job)
         with self._lock:
-            if alive and not job.done:
-                self._queue.append(job)
-            return bool(self._queue)
+            return bool(len(self._queue))
 
     def run_until_idle(self) -> None:
         """Drain the queue inline (start=False mode)."""
@@ -520,11 +806,13 @@ class SweepService:
     def drain(self, timeout: "float | None" = None) -> None:
         """Block until every accepted job reached a terminal state."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        if self._worker is None:
+        if not self._workers:
             self.run_until_idle()
             return
         with self._lock:
-            while self._queue or self._running_job is not None:
+            while (len(self._queue)
+                   or any(w.running_job is not None for w in self._workers)
+                   or self._running_job is not None):
                 wait = (None if deadline is None
                         else max(deadline - time.monotonic(), 0.0))
                 if wait == 0.0:
@@ -539,16 +827,18 @@ class SweepService:
         with self._lock:
             self._closed = True
             if not drain:
-                while self._queue:
-                    job = self._queue.popleft()
+                while len(self._queue):
+                    job = self._queue.pop()
                     self._terminal(job, "cancelled",
                                    JobCancelled("service shutdown"))
             self._lock.notify_all()
         if drain:
             self.drain(timeout)
-        if self._worker is not None:
-            self._worker.join(timeout)
-            self._worker = None
+        for w in self._workers:
+            if w.thread is not None:
+                w.thread.join(timeout)
+        self._workers = []
+        self._worker = None
         if self._journal is not None:
             self._journal.close()
         obs_export.unregister(self._provider_key)
@@ -562,21 +852,66 @@ class SweepService:
 
     # -- one scheduling quantum ------------------------------------------
 
-    def _run_quantum(self, job: SweepJob) -> bool:
+    def _beat(self, worker: "_WorkerSlot | None" = None) -> None:
+        """Advance the current scheduling slot's heartbeat: the worker's
+        own when a pool worker is running the quantum, the service-level
+        one in inline (start=False / step()) mode."""
+        now = time.monotonic()
+        if worker is None:
+            worker = getattr(self._tl, "worker", None)
+        if worker is not None:
+            worker.heartbeat = now
+        else:
+            self._heartbeat = now
+
+    @staticmethod
+    def _device_ctx(worker: "_WorkerSlot | None"):
+        """The worker's device-slot pin: uncommitted computation inside
+        its quanta defaults onto the pinned device when the host has more
+        than one (explicitly-sharded arrays keep their shardings). A
+        single-device host — and the inline mode — runs unpinned."""
+        import contextlib
+        if worker is None or worker.device is None:
+            return contextlib.nullcontext()
+        try:
+            import jax
+            return jax.default_device(worker.device)
+        except Exception:  # pragma: no cover - jax without the API
+            return contextlib.nullcontext()
+
+    def _run_quantum(self, job: SweepJob,
+                     worker: "_WorkerSlot | None" = None) -> bool:
         """Run one slice of `job`. Returns True when the job should be
         re-queued (work remains), False on any terminal state. EVERY
         failure is contained here: nothing a job does may unwind into
         the scheduler loop (per-tenant isolation)."""
-        self._heartbeat = time.monotonic()
+        self._beat(worker)
+        self._tl.worker = worker
+        expired = job._deadline_expired()
         if job.first_quantum_at is None:
+            if expired:
+                # expired while STILL QUEUED: cancel before any work —
+                # and before the queue-wait observation below, so an
+                # expired wait never lands in the SLO histograms (it is
+                # a deadline miss, not a latency datum) and ttfv stays
+                # unset
+                self._note_deadline_miss(job)
+                self._terminal(job, "cancelled", JobCancelled(
+                    f"job {job.job_id} exceeded deadline_sec="
+                    f"{job.deadline_sec} while still queued"))
+                return False
             # queue wait: submit -> the scheduler first picks the job up
             # (the injected stall below bills against the job's SLICE
-            # time, like any slow quantum, not its queue wait)
+            # time, like any slow quantum, not its queue wait); the same
+            # sample feeds the admission governor's sliding window
             job.first_quantum_at = time.monotonic()
+            wait = job.first_quantum_at - job.submitted_at
             obs_metrics.histogram(
-                "service.queue_wait_sec", tenant=job.tenant).observe(
-                    job.first_quantum_at - job.submitted_at)
-        entry = self._plan.get(job.ordinal)
+                "service.queue_wait_sec",
+                tenant=job.tenant).observe(wait)
+            with self._lock:
+                self._admission.observe_queue_wait(wait)
+        entry = job._fault_entry
         if entry is not None and entry.get("stall_sec"):
             sec, entry["stall_sec"] = entry["stall_sec"], 0.0
             obs_trace.event("service.stall", tenant=job.tenant,
@@ -584,7 +919,9 @@ class SweepService:
             logger.warning("service: injected stall of %.2f s before %s",
                            sec, job.job_id)
             time.sleep(sec)
-        if job._deadline_expired():
+            # the stall billed against the job's own deadline
+            expired = expired or job._deadline_expired()
+        if expired:
             self._note_deadline_miss(job)
             self._terminal(job, "cancelled", JobCancelled(
                 f"job {job.job_id} exceeded deadline_sec="
@@ -593,6 +930,13 @@ class SweepService:
         job.status = "running"
         span = obs_trace.start_span("service.slice", tenant=job.tenant,
                                     job=job.job_id)
+        try:
+            with self._device_ctx(worker):
+                return self._run_quantum_body(job, span)
+        finally:
+            self._tl.worker = None
+
+    def _run_quantum_body(self, job: SweepJob, span) -> bool:
         try:
             if job.engine is None:
                 self._build_engine(job)
@@ -698,11 +1042,12 @@ class SweepService:
             # shape-scoped keys: same (slots, width) bucket => same banked
             # program regardless of which tenant's game it serves
             eng.program_bank = ProgramBank(eng, shared=True)
-        entry = self._plan.get(job.ordinal)
+        entry = job._fault_entry
         if entry is not None and entry.get("batch"):
-            # install the job's injected batch faults into ITS engine's
-            # private injector: FaultInjector's fire-once/retry-keeps-
-            # ordinal semantics apply per tenant, exactly as solo
+            # install the job's injected batch faults (explicit plan
+            # merged with the chaos draw) into ITS engine's private
+            # injector: FaultInjector's fire-once/retry-keeps-ordinal
+            # semantics apply per tenant, exactly as solo
             eng._faults = faults.FaultInjector(
                 {k: list(v) for k, v in entry["batch"].items()})
 
@@ -756,7 +1101,7 @@ class SweepService:
         harvested, count cross-tenant packed batches, and enforce the
         deadline cooperatively — raising BETWEEN batches, never inside a
         dispatch."""
-        self._heartbeat = time.monotonic()
+        self._beat()  # the running worker's own heartbeat (thread-local)
         self._journal_new_values(job)
         if job._slice_packed.get(slot_count):
             job.packed_batches += 1
@@ -875,6 +1220,7 @@ class SweepService:
         callers stay alive through their own reference, but the service's
         _jobs map (and its job-id dedupe window) is bounded."""
         with self._lock:
+            self._terminal_seen += 1
             self._terminal_order.append(job.job_id)
             while len(self._terminal_order) > self._max_terminal_jobs:
                 old = self._terminal_order.popleft()
@@ -913,11 +1259,13 @@ class SweepService:
         # dropping it here is what "cancelled without leaking device
         # buffers" means
         job.engine = None
-        kind = "cancel" if status == "cancelled" else "quarantine"
+        kind = {"cancelled": "cancel", "quarantined": "quarantine",
+                "shed": "shed"}[status]
         self._journal_safe({"type": kind, "job": job.job_id,
                             "error": str(err)[:500]})
-        counter = ("service.jobs_cancelled" if status == "cancelled"
-                   else "service.jobs_quarantined")
+        counter = {"cancelled": "service.jobs_cancelled",
+                   "quarantined": "service.jobs_quarantined",
+                   "shed": "service.jobs_shed"}[status]
         obs_metrics.counter(counter).inc()
         obs_metrics.histogram("service.job_attempts",
                               tenant=job.tenant).observe(job.attempts)

@@ -1,0 +1,394 @@
+#!/usr/bin/env python
+"""Synthetic load & chaos harness for the multi-tenant sweep service —
+the benchmark that finds the service's ceiling instead of assuming it.
+
+`BENCH_CONFIG=7` (bench.py) and the fast-tier chaos smoke
+(tests/test_load_harness.py) both drive `run_load()`: submit a stream of
+mixed-shape contributivity games (different partner counts and seeds)
+across priority tiers against ONE running `SweepService`, optionally
+while a seeded chaos plan (`MPLC_TPU_SERVICE_FAULT_PLAN=
+chaos@rate0.05:seed7`, faults.py) injects random crash/stall/transient
+faults, then measure what the service did about it:
+
+  - **saturation throughput** — completed jobs/s and coalitions/s with
+    the admission queue held at its bound by the submission loop (the
+    loop backs off on `ServiceOverloaded` by the error's own
+    `retry_after_sec` hint, so the harness also exercises the backoff
+    contract it documents);
+  - **per-tier tail latency** — exact p50/p95/p99 of queue wait,
+    time-to-first-value and end-to-end seconds per priority tier (each
+    tier submits under its own tenant name, so the sweep report's
+    per-tenant slo row and the live /metrics histograms line up with
+    the harness's own quantiles);
+  - **fairness** — each tier's share of completed work vs its
+    stride-scheduling weight (`tier + 1`), plus the service row's
+    per-tenant cost_share;
+  - **shed / quarantine accounting** — every non-completed outcome by
+    class, with rejected-at-admission and overload-backoff counts.
+
+And the robustness INVARIANT, equality-checked on every run (`report
+["invariant"]`): every ACCEPTED job reaches a terminal state —
+completed, shed, cancelled, or quarantined — none lost, none hung
+(`stuck == 0`); every shed job carries a classified `JobShed` (never a
+silent drop); and every COMPLETED job's v(S) table is bit-identical to
+a solo fault-free engine run of the same game, chaos and overload
+notwithstanding.
+
+Standalone:
+
+    JAX_PLATFORMS=cpu python scripts/load_gen.py --jobs 200 \
+        --chaos 0.05 --chaos-seed 7 --workers 2 --out load_report.json
+
+The service under test is in-process (the engine is a library, not an
+RPC server yet); /metrics is scraped over real HTTP when
+`MPLC_TPU_METRICS_PORT` is set, so the telemetry plane is exercised
+end-to-end too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _amounts(n):
+    a = [float(i + 1) for i in range(n)]
+    return [x / sum(a) for x in a]
+
+
+def default_scenario_builder(partners: int, seed: int, epochs: int = 1,
+                             dataset: str = "titanic"):
+    """A builder returning a FRESH small Scenario per call (each job gets
+    its own — engines must never share mutable scenario state across
+    concurrent workers). titanic: the only family whose trainers compile
+    in seconds on CPU."""
+    def build():
+        from mplc_tpu.scenario import Scenario
+        sc = Scenario(partners_count=partners,
+                      amounts_per_partner=_amounts(partners),
+                      dataset_name=dataset,
+                      multi_partner_learning_approach="fedavg",
+                      aggregation_weighting="data-volume",
+                      epoch_count=epochs, minibatch_count=2,
+                      gradient_updates_per_pass_count=2,
+                      is_early_stopping=False,
+                      experiment_path="/tmp/mplc_loadgen", is_dry_run=True,
+                      seed=seed)
+        sc.instantiate_scenario_partners()
+        sc.split_data(is_logging_enabled=False)
+        sc.compute_batch_sizes()
+        sc.data_corruption()
+        return sc
+    return build
+
+
+def _quantiles(samples) -> dict:
+    from mplc_tpu.service.admission import nearest_rank
+    return {"p50": nearest_rank(samples, 0.50),
+            "p95": nearest_rank(samples, 0.95),
+            "p99": nearest_rank(samples, 0.99),
+            "max": max(samples) if samples else None,
+            "count": len(samples)}
+
+
+def _scrape_metrics() -> "dict | None":
+    """GET /metrics off the live telemetry server (when one is up) and
+    keep the service-level counter samples — proof the Prometheus plane
+    survives a load run, and a second accounting source to cross-check
+    the harness's own counts."""
+    from mplc_tpu.obs import export as obs_export
+    srv = obs_export.active_server()
+    if srv is None:
+        return None
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+    except Exception as e:
+        return {"error": str(e)[:200]}
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("mplc_service_") and " " in line \
+                and "_bucket" not in line and not line.startswith("#"):
+            name, _, val = line.rpartition(" ")
+            try:
+                out[name] = float(val)
+            except ValueError:
+                continue
+    return out
+
+
+def solo_reference(builder) -> dict:
+    """Fault-free solo-engine v(S) table for one game — the bit-identity
+    oracle. Runs OUTSIDE the service on a private engine, exactly the
+    solo run the service's isolation invariant is stated against."""
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+    from mplc_tpu.contrib.shapley import powerset_order
+    eng = CharacteristicEngine(builder())
+    subsets = powerset_order(eng.partners_count)
+    eng.evaluate(subsets)
+    return {s: eng.charac_fct_values[s] for s in subsets}
+
+
+def run_load(jobs: int = 1000,
+             partner_shapes=(2, 3),
+             game_seeds=(0, 1, 2),
+             tiers=(0, 1, 2),
+             epochs: int = 1,
+             dataset: str = "titanic",
+             chaos_plan: "str | None" = None,
+             workers: "int | None" = None,
+             max_pending: "int | None" = None,
+             slice_coalitions: "int | None" = None,
+             shed_p99_sec: "float | None" = None,
+             threaded: bool = True,
+             journal_path=None,
+             timeout_sec: float = 24 * 3600,
+             beat=None,
+             scenario_builder=default_scenario_builder) -> dict:
+    """Drive one load run and return the report dict (module docstring).
+
+    `chaos_plan` is a full `MPLC_TPU_SERVICE_FAULT_PLAN` string (chaos
+    and/or explicit entries), installed for the service's lifetime and
+    restored afterwards. `threaded=False` runs the deterministic inline
+    harness (`start=False` + `step()`) the fast-tier smoke uses: the
+    submission loop interleaves stepping with submitting, so overload,
+    shedding and chaos all fire on a fixed, replayable schedule.
+    `beat` is an optional liveness callback (the bench watchdog)."""
+    import numpy as np
+
+    from mplc_tpu import faults
+    from mplc_tpu.obs import trace as obs_trace
+    from mplc_tpu.service import (JobShed, ServiceOverloaded,
+                                  ServiceRejected, SweepService)
+
+    beat = beat or (lambda: None)
+    games = [(p, s, scenario_builder(p, s, epochs=epochs, dataset=dataset))
+             for p in partner_shapes for s in game_seeds]
+
+    env_key = faults.SERVICE_FAULT_PLAN_ENV
+    saved_plan = os.environ.get(env_key)
+    if chaos_plan is not None:
+        os.environ[env_key] = chaos_plan
+    try:
+        svc = SweepService(start=threaded, workers=workers,
+                           max_pending=max_pending,
+                           slice_coalitions=slice_coalitions,
+                           shed_p99_sec=shed_p99_sec,
+                           journal_path=journal_path)
+    finally:
+        if chaos_plan is not None:
+            if saved_plan is None:
+                os.environ.pop(env_key, None)
+            else:
+                os.environ[env_key] = saved_plan
+
+    accepted = []          # (job handle, game index, tier)
+    rejected_plan = 0
+    overload_backoffs = 0
+    retry_after_hints = []
+    t0 = time.monotonic()
+    deadline = t0 + timeout_sec
+
+    with obs_trace.collect() as recs:
+        for i in range(jobs):
+            gi = i % len(games)
+            tier = tiers[i % len(tiers)]
+            builder = games[gi][2]
+            sc = builder()
+            while True:
+                beat()
+                try:
+                    job = svc.submit(sc, tenant=f"tier{tier}",
+                                     priority=tier)
+                    accepted.append((job, gi, tier))
+                    break
+                except ServiceOverloaded as e:
+                    # the backpressure contract under test: back off by
+                    # the error's own hint instead of hammering submit
+                    overload_backoffs += 1
+                    retry_after_hints.append(e.retry_after_sec)
+                    if threaded:
+                        time.sleep(min(max(e.retry_after_sec, 0.005), 1.0))
+                    else:
+                        if not svc.step():
+                            time.sleep(0.005)
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            "load run could not drain the admission "
+                            "queue within timeout_sec")
+                except ServiceRejected:
+                    rejected_plan += 1
+                    break
+        # drain every accepted job to a terminal state
+        if threaded:
+            stuck = []
+            for job, _, _ in accepted:
+                remaining = max(deadline - time.monotonic(), 0.0)
+                if not job._done.wait(remaining):
+                    stuck.append(job.job_id)
+                beat()
+            svc.shutdown(drain=True, timeout=max(
+                deadline - time.monotonic(), 1.0))
+        else:
+            svc.run_until_idle()
+            svc.shutdown(drain=False)
+            stuck = [job.job_id for job, _, _ in accepted if not job.done]
+    elapsed = time.monotonic() - t0
+
+    # -- outcome accounting + the invariant -------------------------------
+    from mplc_tpu.contrib.shapley import powerset_order
+
+    refs: dict = {}
+    outcomes: dict = {}
+    mismatched = []
+    unclassified_sheds = []
+    completed_coalitions = 0
+    for job, gi, tier in accepted:
+        outcomes[job.status] = outcomes.get(job.status, 0) + 1
+        if job.status == "completed":
+            partners, seed, builder = games[gi]
+            if gi not in refs:
+                refs[gi] = solo_reference(builder)
+                beat()
+            subsets = powerset_order(partners)
+            got = np.array([job.values[s] for s in subsets])
+            want = np.array([refs[gi][s] for s in subsets])
+            completed_coalitions += len(subsets)
+            if not np.array_equal(got, want):
+                mismatched.append(job.job_id)
+        elif job.status == "shed":
+            if not isinstance(job.error, JobShed) or \
+                    job.error.retry_after_sec < 0.0:
+                unclassified_sheds.append(job.job_id)
+
+    terminal = {"completed", "shed", "cancelled", "quarantined"}
+    invariant = {
+        "accepted": len(accepted),
+        "terminal": sum(v for k, v in outcomes.items() if k in terminal),
+        "stuck": len(stuck),
+        "stuck_jobs": stuck[:20],
+        "nonterminal_statuses": sorted(
+            k for k in outcomes if k not in terminal),
+        "completed_games_checked": len(refs),
+        "values_bit_identical_to_solo": not mismatched,
+        "mismatched_jobs": mismatched[:20],
+        "sheds_classified": not unclassified_sheds,
+        "holds": (not stuck and not mismatched and not unclassified_sheds
+                  and all(k in terminal for k in outcomes)),
+    }
+
+    # -- per-tier latency + fairness from the collected trace -------------
+    per_tier: dict = {}
+    job_events = [r for r in recs if r.get("name") == "service.job"]
+    for tier in sorted(set(tiers)):
+        tn = f"tier{tier}"
+        evs = [r["attrs"] for r in job_events
+               if r.get("attrs", {}).get("tenant") == tn]
+        done = [a for a in evs if a.get("status") == "completed"]
+        per_tier[str(tier)] = {
+            "weight": tier + 1,
+            "jobs": len(evs),
+            "completed": len(done),
+            "shed": sum(1 for a in evs if a.get("status") == "shed"),
+            "queue_wait_s": _quantiles(
+                [a["queue_wait_sec"] for a in evs
+                 if a.get("queue_wait_sec") is not None]),
+            "ttfv_s": _quantiles(
+                [a["ttfv_sec"] for a in evs
+                 if a.get("ttfv_sec") is not None]),
+            "e2e_s": _quantiles(
+                [a["seconds"] for a in done
+                 if a.get("seconds") is not None]),
+        }
+    total_weight = sum(t + 1 for t in tiers) or 1
+    total_completed = sum(t["completed"] for t in per_tier.values()) or 1
+    for tier in per_tier.values():
+        tier["completed_share"] = tier["completed"] / total_completed
+        tier["weight_share"] = tier["weight"] / total_weight
+
+    from mplc_tpu.obs.report import sweep_report
+    rep = sweep_report(recs)
+
+    return {
+        "params": {
+            "jobs": jobs, "partner_shapes": list(partner_shapes),
+            "game_seeds": list(game_seeds), "tiers": list(tiers),
+            "epochs": epochs, "dataset": dataset,
+            "chaos_plan": chaos_plan, "workers": svc._n_workers,
+            "max_pending": svc._max_pending,
+            "slice_coalitions": svc._slice,
+            "shed_p99_sec": svc._admission.shed_p99_sec,
+            "threaded": threaded,
+        },
+        "wallclock_s": elapsed,
+        "saturation": {
+            "accepted": len(accepted),
+            "completed_jobs_per_s": outcomes.get("completed", 0) / elapsed
+            if elapsed else None,
+            "completed_coalitions_per_s": completed_coalitions / elapsed
+            if elapsed else None,
+            "completed_coalitions": completed_coalitions,
+            "overload_backoffs": overload_backoffs,
+            "retry_after_hint_s": _quantiles(retry_after_hints),
+            "rejected_by_fault_plan": rejected_plan,
+        },
+        "outcomes": outcomes,
+        "per_tier": per_tier,
+        "invariant": invariant,
+        "admission": svc._admission.view(),
+        "metrics_scrape": _scrape_metrics(),
+        "service_report": {k: rep[k] for k in ("service", "slo",
+                                               "resilience")
+                           if k in rep},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--jobs", type=int, default=1000)
+    ap.add_argument("--chaos", type=float, default=0.0,
+                    help="chaos fault rate (0 disables)")
+    ap.add_argument("--chaos-seed", type=int, default=7)
+    ap.add_argument("--workers", type=int, default=None)
+    ap.add_argument("--max-pending", type=int, default=None)
+    ap.add_argument("--slice", type=int, default=None)
+    ap.add_argument("--shed-p99-sec", type=float, default=None)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--timeout-sec", type=float, default=24 * 3600)
+    ap.add_argument("--out", default=None,
+                    help="write the JSON report here (default stdout)")
+    args = ap.parse_args(argv)
+
+    chaos_plan = (f"chaos@rate{args.chaos}:seed{args.chaos_seed}"
+                  if args.chaos > 0 else None)
+    report = run_load(jobs=args.jobs, chaos_plan=chaos_plan,
+                      workers=args.workers, max_pending=args.max_pending,
+                      slice_coalitions=args.slice,
+                      shed_p99_sec=args.shed_p99_sec, epochs=args.epochs,
+                      timeout_sec=args.timeout_sec)
+    text = json.dumps(report, indent=2, default=str)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"[load_gen] report: {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    inv = report["invariant"]
+    print(f"[load_gen] invariant holds: {inv['holds']} "
+          f"(accepted={inv['accepted']} stuck={inv['stuck']} "
+          f"bit_identical={inv['values_bit_identical_to_solo']})",
+          file=sys.stderr)
+    return 0 if inv["holds"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,498 @@
+"""Overload robustness: priority scheduling, the SLO-driven admission
+governor, load shedding, the worker pool, and the /healthz//varz
+overload surfaces (mplc_tpu/service/admission.py + scheduler.py).
+
+Governing contracts, asserted throughout:
+
+  - WEIGHTED, NOT STARVED: tier t gets ~(t+1) quanta per tier-0 quantum
+    (stride scheduling), FIFO within a tier; a single-tier service
+    schedules exactly like the PR-9 deque.
+  - SHED, NEVER LOST: when queue-wait p99 crosses the threshold the
+    governor defers then sheds lowest-tier never-started jobs with a
+    classified, journaled `JobShed` carrying a `retry_after_sec` hint —
+    counted separately from rejected/cancelled/quarantined.
+  - EXPIRED-WHILE-QUEUED is a deadline miss, not a latency datum: one
+    `service.deadline_misses` beat, no queue-wait/ttfv SLO sample.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mplc_tpu import faults
+from mplc_tpu.contrib.engine import CharacteristicEngine
+from mplc_tpu.contrib.shapley import powerset_order
+from mplc_tpu.obs import metrics, trace
+from mplc_tpu.service import (AdmissionController, JobShed,
+                              ServiceOverloaded, SweepJob, SweepService,
+                              TierQueue)
+
+P = 3
+SUBSETS = powerset_order(P)
+
+_KNOBS = ("MPLC_TPU_SERVICE_FAULT_PLAN", "MPLC_TPU_SERVICE_MAX_PENDING",
+          "MPLC_TPU_SERVICE_SLICE", "MPLC_TPU_SERVICE_WORKERS",
+          "MPLC_TPU_SERVICE_PRIORITY_DEFAULT",
+          "MPLC_TPU_SERVICE_SHED_P99_SEC", "MPLC_TPU_FAULT_PLAN",
+          "MPLC_TPU_MAX_RETRIES", "MPLC_TPU_SEED_ENSEMBLE",
+          "MPLC_TPU_PARTNER_FAULT_PLAN")
+
+
+@pytest.fixture(autouse=True)
+def _env(monkeypatch):
+    for k in _KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def scenario(seed):
+    from helpers import build_scenario
+    return build_scenario(partners_count=P, dataset_name="titanic",
+                          epoch_count=2, gradient_updates_per_pass_count=2,
+                          seed=seed)
+
+
+_REF = {}
+
+
+def solo_values(seed):
+    if seed not in _REF:
+        _REF[seed] = CharacteristicEngine(scenario(seed)).evaluate(SUBSETS)
+    return _REF[seed]
+
+
+def values_of(job):
+    return np.array([job.values[s] for s in SUBSETS])
+
+
+def _counter(name):
+    return metrics.snapshot()["counters"].get(name, 0)
+
+
+class _FakeJob:
+    """Queue-unit stand-in: only priority / first_quantum_at matter."""
+
+    def __init__(self, name, priority=0, started=False):
+        self.name = name
+        self.priority = priority
+        self.first_quantum_at = 0.0 if started else None
+        self.submitted_at = time.monotonic()
+
+    def __repr__(self):
+        return self.name
+
+
+# -- TierQueue ----------------------------------------------------------------
+
+def test_tier_queue_single_tier_is_fifo():
+    q = TierQueue()
+    jobs = [_FakeJob(f"j{i}") for i in range(4)]
+    for j in jobs:
+        q.push(j)
+    assert [q.pop() for _ in range(4)] == jobs
+    assert q.pop() is None
+
+
+def test_tier_queue_stride_weights_quanta_by_tier():
+    """Tier 1 (weight 2) gets two quanta per tier-0 (weight 1) quantum;
+    neither tier ever starves."""
+    q = TierQueue()
+    lo, hi = _FakeJob("lo", 0), _FakeJob("hi", 1)
+    order = []
+    for _ in range(9):
+        j = q.pop() if len(q) else None
+        if j is None:
+            q.push(lo), q.push(hi)
+            continue
+        order.append(j.name)
+        q.push(j)  # round-robin re-queue, like the scheduler
+    hi_n, lo_n = order.count("hi"), order.count("lo")
+    assert lo_n >= 2  # no starvation
+    assert 1.5 <= hi_n / lo_n <= 2.5  # ~weight ratio 2:1
+
+
+def test_tier_queue_defer_lowest_skips_only_when_another_tier_queued():
+    q = TierQueue()
+    lo, hi = _FakeJob("lo", 0), _FakeJob("hi", 2)
+    q.push(lo)
+    # deferral with a single queued tier is a no-op, never a deadlock
+    assert q.pop(defer_lowest=True) is lo
+    q.push(lo), q.push(hi)
+    assert q.pop(defer_lowest=True) is hi
+    q.push(hi)
+    assert q.pop(defer_lowest=True) is hi  # lo deferred while hi queued
+    assert q.pop(defer_lowest=True) is lo  # hi drained -> lo runs again
+
+
+def test_tier_queue_shed_candidates_newest_first_never_started_only():
+    q = TierQueue()
+    started = _FakeJob("started", 0, started=True)
+    a, b, c = (_FakeJob(n, 0) for n in "abc")
+    hi = _FakeJob("hi", 1)
+    for j in (started, a, b, c, hi):
+        q.push(j)
+    victims = q.shed_candidates(2)
+    # newest never-started from the LOWEST tier; the started job and the
+    # higher tier are untouchable
+    assert victims == [c, b]
+    assert set(q.jobs()) == {started, a, hi}
+    assert q.shed_candidates(0) == []
+
+
+# -- AdmissionController ------------------------------------------------------
+
+def test_controller_disabled_never_leaves_healthy():
+    c = AdmissionController(0.0)
+    for _ in range(3):
+        assert c.evaluate([100.0, 200.0]) == "healthy"
+    assert c.view()["state"] == "healthy"
+    assert c.view()["enabled"] is False
+
+
+def test_controller_escalates_defer_then_shed_and_recovers():
+    c = AdmissionController(1.0, defer_dwell_sec=0.0)
+    assert c.evaluate([0.1]) == "healthy"
+    assert c.evaluate([5.0]) == "deferring"   # first breach: defer
+    assert c.evaluate([5.0]) == "shedding"    # still over past dwell: shed
+    assert c.evaluate([5.0]) == "shedding"
+    assert c.evaluate([0.1]) == "healthy"     # windowed p99 recovered
+    assert c.evaluate([5.0]) == "deferring"   # a new breach defers again
+
+
+def test_controller_dwell_blocks_instant_escalation():
+    """Deferral must get wall-clock time to relieve the p99 before jobs
+    are destroyed — two scheduling decisions microseconds apart (a
+    worker pool's reality) must NOT jump deferring -> shedding."""
+    c = AdmissionController(1.0, defer_dwell_sec=0.05)
+    assert c.evaluate([5.0]) == "deferring"
+    assert c.evaluate([5.0]) == "deferring"   # within the dwell
+    time.sleep(0.06)
+    assert c.evaluate([5.0]) == "shedding"    # breach outlived the dwell
+
+
+def test_controller_window_ages_out_a_spike():
+    """A post-spike idle service must stop reporting breach-level p99
+    even when nothing new is scheduled: stale samples are pruned by AGE,
+    not only displaced by count."""
+    c = AdmissionController(1.0, defer_dwell_sec=0.0)
+    c._waits.append((time.monotonic() - 1e6, 50.0))  # ancient spike wait
+    assert c.evaluate([]) == "healthy"
+    assert len(c._waits) == 0  # pruned
+    assert c.retry_after_sec() == 0.0
+
+
+def test_controller_sees_stuck_queue_through_live_ages():
+    """No samples ever observed (nothing scheduled) — the live queued
+    ages alone must trip the governor."""
+    c = AdmissionController(1.0)
+    assert c.evaluate([]) == "healthy"
+    assert c.evaluate([2.0, 3.0]) == "deferring"
+
+
+def test_controller_retry_after_is_windowed_p50():
+    c = AdmissionController(1.0)
+    assert c.retry_after_sec() == 0.0  # no history
+    for w in (0.2, 0.4, 0.6):
+        c.observe_queue_wait(w)
+    assert c.retry_after_sec() == pytest.approx(0.4)
+
+
+def test_controller_shed_quota_targets_half_the_bound():
+    c = AdmissionController(1.0, defer_dwell_sec=0.0)
+    c.evaluate([5.0])
+    c.evaluate([5.0])
+    assert c.state == "shedding"
+    assert c.shed_quota(queued=10, max_pending=8) == 6  # down to 4
+    assert c.shed_quota(queued=5, max_pending=8) == 1
+    # at or below the half-bound target there is no backlog to cut:
+    # the next job must RUN (and land a fresh wait sample), not die to
+    # a stale-window breach
+    assert c.shed_quota(queued=1, max_pending=8) == 0
+    assert c.shed_quota(queued=0, max_pending=8) == 0
+    c.evaluate([0.0])
+    assert c.shed_quota(queued=10, max_pending=8) == 0  # healthy: none
+
+
+# -- ServiceOverloaded carries retry_after_sec (satellite) --------------------
+
+def test_overloaded_carries_retry_after_hint():
+    svc = SweepService(start=False, max_pending=1, slice_coalitions=3)
+    svc.submit(scenario(9), tenant="A")
+    with pytest.raises(ServiceOverloaded) as ei:
+        svc.submit(scenario(11), tenant="B")
+    # no job ever scheduled: the hint is exactly 0.0, never None/garbage
+    assert ei.value.retry_after_sec == 0.0
+    svc.run_until_idle()
+    # with queue-wait history the hint is the live p50 (> 0) and is
+    # stamped into the message too
+    svc.submit(scenario(11), tenant="B")
+    with pytest.raises(ServiceOverloaded) as ei:
+        svc.submit(scenario(13), tenant="C")
+    assert ei.value.retry_after_sec > 0.0
+    assert "retry_after_sec" in str(ei.value)
+
+
+# -- priority scheduling end-to-end -------------------------------------------
+
+def test_higher_priority_job_gets_first_quantum_and_both_complete():
+    ref_a, ref_b = solo_values(9), solo_values(11)
+    svc = SweepService(start=False, slice_coalitions=2)
+    lo = svc.submit(scenario(9), tenant="lo", priority=0)
+    hi = svc.submit(scenario(11), tenant="hi", priority=3)
+    svc.step()
+    assert hi.first_quantum_at is not None  # weight 4 wins the tie
+    assert lo.first_quantum_at is None
+    svc.run_until_idle()
+    assert lo.status == hi.status == "completed"
+    np.testing.assert_array_equal(values_of(lo), ref_a)
+    np.testing.assert_array_equal(values_of(hi), ref_b)
+
+
+def test_priority_default_env_applies(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_SERVICE_PRIORITY_DEFAULT", "2")
+    svc = SweepService(start=False)
+    job = svc.submit(scenario(9), tenant="A")
+    assert job.priority == 2
+    explicit = svc.submit(scenario(11), tenant="B", priority=0)
+    assert explicit.priority == 0
+    with pytest.raises(ValueError, match="non-negative"):
+        svc.submit(scenario(13), tenant="C", priority=-1)
+
+
+# -- load shedding end-to-end -------------------------------------------------
+
+def test_overload_sheds_lowest_tier_with_classified_jobshed(tmp_path):
+    """The tentpole behavior: under a breached queue-wait SLO the
+    governor sheds lowest-tier never-started jobs — classified JobShed
+    (with retry_after_sec), journaled, counted in service.jobs_shed —
+    and the surviving higher-tier jobs complete bit-identically."""
+    ref_b = solo_values(11)
+    path = tmp_path / "wal.jsonl"
+    # max_pending=4 => shed target is 2 queued: the 3-deep backlog is
+    # over target, so the breached governor has a quota to shed
+    svc = SweepService(start=False, slice_coalitions=2, max_pending=4,
+                       shed_p99_sec=1e-9, journal_path=path)
+    lo1 = svc.submit(scenario(9), tenant="lo", priority=0, job_id="lo1")
+    lo2 = svc.submit(scenario(9), tenant="lo", priority=0, job_id="lo2")
+    hi = svc.submit(scenario(11), tenant="hi", priority=1, job_id="hi")
+    time.sleep(0.002)  # any positive queued age breaches the 1ns SLO
+    with trace.collect() as recs:
+        svc.run_until_idle()
+    assert hi.status == "completed"
+    np.testing.assert_array_equal(values_of(hi), ref_b)
+    shed = [j for j in (lo1, lo2) if j.status == "shed"]
+    assert shed, "the breached governor shed no lowest-tier job"
+    for job in shed:
+        assert isinstance(job.error, JobShed)
+        assert job.error.retry_after_sec >= 0.0
+        with pytest.raises(JobShed, match="shed by overload"):
+            job.result(1.0)
+        # shed jobs never ran: no engine, no device buffers, no samples
+        assert job.engine is None and job.first_quantum_at is None
+    assert _counter("service.jobs_shed") == len(shed)
+    assert _counter("service.jobs_cancelled") == 0
+    assert _counter("service.jobs_quarantined") == 0
+    assert [r for r in recs if r["name"] == "service.shed"]
+    # journaled as its own record kind, visible after a restart
+    svc.shutdown()
+    svc2 = SweepService(journal_path=path, start=False)
+    rec = {r["job_id"]: r for r in svc2.recovered_jobs()}
+    assert any(rec[j.job_id]["shed"] for j in shed)
+    svc2.shutdown()
+    # and the report classifies them separately
+    from mplc_tpu.obs import report
+    rep = report.sweep_report(recs)
+    assert rep["service"]["shed"] == len(shed)
+    assert f"shed={len(shed)}" in report.format_report(rep)
+
+
+def test_shed_disabled_by_default_no_governor_interference():
+    """With MPLC_TPU_SERVICE_SHED_P99_SEC unset the governor never
+    defers or sheds — PR-9 behavior exactly."""
+    svc = SweepService(start=False, slice_coalitions=3)
+    assert svc._admission.enabled is False
+    jobs = [svc.submit(scenario(9), tenant=f"t{i}") for i in range(3)]
+    time.sleep(0.002)
+    svc.run_until_idle()
+    assert all(j.status == "completed" for j in jobs)
+    assert _counter("service.jobs_shed") == 0
+
+
+# -- deadline expiry while still queued (satellite) ---------------------------
+
+def test_deadline_expiry_while_queued_cancels_without_slo_samples():
+    """A job whose deadline elapses before its FIRST quantum must cancel
+    cleanly, beat service.deadline_misses exactly once, and record
+    neither a queue_wait nor a ttfv sample — an expired wait is not a
+    latency datum."""
+    svc = SweepService(start=False, slice_coalitions=2)
+    job = svc.submit(scenario(9), tenant="Q", deadline_sec=1000.0)
+    job.submitted_at -= 10_000  # expired while queued
+    svc.run_until_idle()
+    assert job.status == "cancelled"
+    assert job.engine is None
+    assert job.first_quantum_at is None and job.first_value_at is None
+    assert _counter("service.deadline_misses{tenant=Q}") == 1
+    hists = metrics.snapshot()["histograms"]
+    assert "service.queue_wait_sec{tenant=Q}" not in hists
+    assert "service.time_to_first_value_sec{tenant=Q}" not in hists
+    # and the service keeps serving afterwards
+    ok = svc.submit(scenario(11), tenant="Q2")
+    svc.run_until_idle()
+    assert ok.status == "completed"
+
+
+# -- worker pool --------------------------------------------------------------
+
+def test_worker_pool_completes_tenants_bit_identically():
+    ref_a, ref_b = solo_values(9), solo_values(11)
+    svc = SweepService(start=True, workers=3, slice_coalitions=3)
+    try:
+        ja = svc.submit(scenario(9), tenant="A")
+        jb = svc.submit(scenario(11), tenant="B")
+        ja.result(timeout=300)
+        jb.result(timeout=300)
+    finally:
+        svc.shutdown(drain=True, timeout=60)
+    np.testing.assert_array_equal(values_of(ja), ref_a)
+    np.testing.assert_array_equal(values_of(jb), ref_b)
+
+
+def test_workers_env_knob_and_healthz_per_worker_block(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_SERVICE_WORKERS", "2")
+    svc = SweepService(start=True)
+    try:
+        view = svc.health_view()
+        assert view["healthy"] is True
+        import jax
+        n_dev = len(jax.local_devices())
+        workers = [w for w in view["workers"] if w["worker"] != "inline"]
+        assert len(workers) == 2
+        for i, w in enumerate(sorted(workers, key=lambda w: w["worker"])):
+            assert w["alive"] is True and w["stalled"] is False
+            assert w["device_slot"] == i % n_dev  # round-robin pinning
+        assert view["admission"]["state"] == "healthy"
+        job = svc.submit(scenario(9), tenant="A")
+        job.result(timeout=300)
+    finally:
+        svc.shutdown(drain=True, timeout=60)
+
+
+def test_one_wedged_worker_flips_only_its_own_liveness():
+    """The per-worker heartbeat contract: with a sibling actively
+    beating, a stale worker with a running job marks ITSELF stalled but
+    the service stays healthy; when EVERY busy slot is wedged the
+    service flips unhealthy (the single-worker degenerate case is the
+    PR-10 rule unchanged)."""
+    svc = SweepService(start=False)
+    try:
+        from mplc_tpu.service import scheduler as sched
+        w0 = sched._WorkerSlot(0)
+        w1 = sched._WorkerSlot(1)
+        svc._workers = [w0, w1]
+        w0.running_job = _FakeJob("wedged")
+        w0.running_job.job_id = "wedged"
+        w0.heartbeat = time.monotonic() - (sched.STALL_HEALTHY_SEC + 1)
+        w1.running_job = _FakeJob("fine")
+        w1.running_job.job_id = "fine"
+        w1.heartbeat = time.monotonic()
+        view = svc.health_view()
+        by_idx = {w["worker"]: w for w in view["workers"]}
+        assert by_idx[0]["stalled"] is True
+        assert by_idx[1]["stalled"] is False
+        assert view["healthy"] is True      # a sibling is alive and well
+        assert view["stalled"] is True      # ... but the wedge is visible
+        w1.heartbeat = time.monotonic() - (sched.STALL_HEALTHY_SEC + 1)
+        assert svc.health_view()["healthy"] is False  # all busy slots wedged
+    finally:
+        svc._workers = []
+        svc.shutdown()
+
+
+# -- /varz truncation (satellite) ---------------------------------------------
+
+def test_varz_truncates_terminal_jobs_to_most_recent_100():
+    svc = SweepService(start=False)
+    try:
+        # synthesize a load-gen run's worth of terminal jobs (real sweeps
+        # would take minutes; the truncation logic only reads bookkeeping)
+        for i in range(130):
+            job = SweepJob(svc, f"t{i}", "tenant", None, "Shapley values",
+                           None, i + 1)
+            job.status = "completed"
+            job._done.set()
+            svc._jobs[job.job_id] = job
+            svc._retire(job)
+        live = SweepJob(svc, "live", "tenant", None, "Shapley values",
+                        None, 999)
+        svc._jobs["live"] = live
+        view = svc.varz_view()
+        terminal_rows = [k for k, v in view["jobs"].items()
+                         if v["status"] == "completed"]
+        assert len(terminal_rows) == svc.VARZ_TERMINAL_JOBS == 100
+        # the most RECENT terminals survive; the oldest are truncated
+        assert "t129" in view["jobs"] and "t29" not in view["jobs"]
+        assert "live" in view["jobs"]  # non-terminal always listed
+        assert view["terminal_jobs_total"] == 130
+        assert view["terminal_jobs_truncated"] == 30
+        assert view["jobs_total"] == 131
+        assert view["admission"]["state"] == "healthy"
+    finally:
+        svc.shutdown()
+
+
+# -- chaos plan grammar -------------------------------------------------------
+
+def test_chaos_plan_grammar_and_validation():
+    plan = faults.parse_service_fault_plan(
+        "chaos@rate0.25:seed7,crash@job2:batch1")
+    assert plan["chaos"] == {"rate": 0.25, "seed": 7}
+    assert plan[2]["batch"] == {("dispatch", 1): ["crash"]}
+    with pytest.warns(UserWarning, match="rate must be in"):
+        assert "chaos" not in faults.parse_service_fault_plan(
+            "chaos@rate1.5:seed7")
+    with pytest.warns(UserWarning, match="duplicate chaos"):
+        plan = faults.parse_service_fault_plan(
+            "chaos@rate0.1:seed1,chaos@rate0.9:seed2")
+    assert plan["chaos"] == {"rate": 0.1, "seed": 1}
+    with pytest.warns(UserWarning, match="malformed"):
+        faults.parse_service_fault_plan("chaos@rate0.1")
+
+
+def test_chaos_draws_are_deterministic_in_seed_and_ordinal():
+    cfg = {"rate": 0.5, "seed": 7}
+    draws = [faults.chaos_entry(cfg, i) for i in range(1, 101)]
+    again = [faults.chaos_entry(cfg, i) for i in range(1, 101)]
+    assert draws == again  # replayable under any interleaving
+    fired = [d for d in draws if d]
+    assert 25 <= len(fired) <= 75  # ~rate 0.5
+    # every fired entry is one crash/transient batch fault or one stall
+    for d in fired:
+        kinds = [k for ks in d["batch"].values() for k in ks]
+        assert (kinds and set(kinds) <= {"crash", "transient"}) \
+            or d["stall_sec"] > 0
+        assert not d["reject"]
+    assert faults.chaos_entry(None, 1) is None
+    assert faults.chaos_entry({"rate": 0.0, "seed": 1}, 1) is None
+    # a different seed reshuffles the draws
+    other = [faults.chaos_entry({"rate": 0.5, "seed": 8}, i)
+             for i in range(1, 101)]
+    assert other != draws
+
+
+def test_merge_service_entries_composes_explicit_and_chaos():
+    explicit = {"batch": {("dispatch", 1): ["crash"]}, "reject": False,
+                "stall_sec": 0.5}
+    chaos = {"batch": {("dispatch", 1): ["transient"]}, "reject": False,
+             "stall_sec": 0.1}
+    merged = faults.merge_service_entries(explicit, chaos)
+    assert merged["batch"][("dispatch", 1)] == ["crash", "transient"]
+    assert merged["stall_sec"] == pytest.approx(0.6)
+    assert faults.merge_service_entries(None, None) is None
+    assert faults.merge_service_entries(explicit, None)["stall_sec"] == 0.5
