@@ -215,6 +215,9 @@ _WORKLOAD_KNOBS = (
     # from a different cache state is a different workload — and the CPU
     # child configures its own cache dir
     "MPLC_TPU_COMPILE_CACHE_DIR",
+    # fenced batches run without overlap and pay an extra sync — a
+    # different fence rate is a different measurement protocol
+    "MPLC_TPU_DEVICE_FENCE_RATE",
     # donation reshapes the HBM-derived batch cap (bucket widths) and the
     # bank reshapes what a measured run pays in compile time
     "MPLC_TPU_DONATE_BUFFERS", "MPLC_TPU_PROGRAM_BANK",
@@ -356,6 +359,7 @@ def _spawn_cpu_fallback() -> int:
             # corrupt the telemetry of the process that spawned it
             "BENCH_TELEMETRY_FILE", "MPLC_TPU_TRACE_FILE",
             "MPLC_TPU_PROFILE_DIR", "MPLC_TPU_METRICS_PORT",
+            "MPLC_TPU_METRICS_TOKEN",
             "MPLC_TPU_FLIGHT_RECORDER_DIR",
             "MPLC_TPU_FLIGHT_RECORDER_SIZE",
             "MPLC_TPU_CHROME_TRACE_FILE"):
@@ -575,17 +579,15 @@ def _fwd_flops_per_sample(engine):
 
 
 def _peak_flops_per_chip():
-    """bf16 peak of the attached chip; None = unknown kind.
-
-    Sources: Google Cloud TPU public spec pages — v4 275 TFLOP/s bf16,
-    v5e 197, v5p 459, v6e (Trillium) 918."""
+    """bf16 peak of the attached chip (obs/devcost.py chip tables —
+    Google Cloud TPU public spec pages); None = unknown kind."""
     import jax
+
+    from mplc_tpu.obs import devcost
     kind = jax.devices()[0].device_kind.lower()
-    table = {"tpu v5 lite": 197e12, "tpu v5e": 197e12, "tpu v5p": 459e12,
-             "tpu v4": 275e12, "tpu v6 lite": 918e12, "tpu v6e": 918e12}
-    for k, v in table.items():
-        if k in kind:
-            return v
+    peak = devcost.peak_flops_per_chip(kind)
+    if peak is not None:
+        return peak
     if kind == "cpu":
         # the CPU-fallback path, not a gap in the table: MFU is a TPU
         # metric and simply doesn't apply here
@@ -597,19 +599,22 @@ def _peak_flops_per_chip():
 
 
 def _compute_inputs(engine):
-    """(fwd FLOPs/sample, fleet peak FLOPs) — the MFU-proxy inputs, probed
-    ONCE per bench run and shared by the throughput note and the sweep
-    report (the XLA cost-model lowering and the device-kind query are not
-    free, and probing twice doubled their stderr notes). FLOPs prefer
-    XLA's cost model, falling back to the analytic models/zoo estimate;
-    peak is the whole attached fleet's (samples_trained aggregates across
-    devices), None when the chip kind is unknown or host-CPU."""
+    """(fwd FLOPs/sample, fleet peak FLOPs, fleet HBM bytes/s) — the
+    MFU-proxy and roofline inputs, probed ONCE per bench run and shared
+    by the throughput note and the sweep report (the XLA cost-model
+    lowering and the device-kind query are not free, and probing twice
+    doubled their stderr notes). FLOPs prefer XLA's cost model, falling
+    back to the analytic models/zoo estimate; peak/bandwidth are the
+    whole attached fleet's (samples_trained aggregates across devices),
+    None when the chip kind is unknown or host-CPU."""
+    from mplc_tpu.obs import devcost
     flops = _fwd_flops_per_sample(engine)
     if flops is None:
         from mplc_tpu.models.zoo import fwd_flops_per_sample
         flops = fwd_flops_per_sample(engine.model.name)
     peak = _peak_flops_per_chip()
-    return flops, (peak * _ndev() if peak else None)
+    return (flops, (peak * _ndev() if peak else None),
+            devcost.fleet_hbm_bytes_per_s())
 
 
 def _throughput_note(engine, elapsed, flops=None, fleet_peak=None):
@@ -765,11 +770,12 @@ def bench_exact_shapley(epochs, dtype):
           f"{elapsed / B:.3f} s/coalition on {_ndev()} device(s); projected "
           f"v5e-8 (8-way coal sharding, zero-communication axis => ~linear): "
           f"{elapsed / 8:.1f} s", file=sys.stderr)
-    flops, fleet_peak = _compute_inputs(timed)
+    flops, fleet_peak, fleet_hbm = _compute_inputs(timed)
     _throughput_note(timed, elapsed, flops, fleet_peak)
     metric = f"exact_shapley_{dataset}_{n_partners}partners_{epochs}epochs_wallclock"
     from mplc_tpu.obs.report import format_report, sweep_report
-    rep = sweep_report(tele, flops_per_sample=flops, peak_flops=fleet_peak)
+    rep = sweep_report(tele, flops_per_sample=flops, peak_flops=fleet_peak,
+                       hbm_bytes_per_s=fleet_hbm)
     print(format_report(rep), file=sys.stderr, flush=True)
     _write_telemetry({"metric": metric, "wallclock_s": elapsed,
                       "devices": _ndev(), "degraded": _degraded_run(rep),
@@ -929,12 +935,13 @@ def _bench_method(dataset_name, n_partners, method, epochs, dtype,
     print(f"[bench] engine.evaluate {engine_time['s']:.1f} s, host-side "
           f"estimator {host:.1f} s ({100 * host / max(elapsed, 1e-9):.1f}% "
           f"of wall-clock)", file=sys.stderr)
-    flops, fleet_peak = _compute_inputs(timed)
+    flops, fleet_peak, fleet_hbm = _compute_inputs(timed)
     _throughput_note(timed, elapsed, flops, fleet_peak)
     tag = method.lower().replace(" ", "_")
     metric = f"{tag}_{dataset_name}_{n_partners}partners_{epochs}epochs_wallclock"
     from mplc_tpu.obs.report import format_report, sweep_report
-    rep = sweep_report(tele, flops_per_sample=flops, peak_flops=fleet_peak)
+    rep = sweep_report(tele, flops_per_sample=flops, peak_flops=fleet_peak,
+                       hbm_bytes_per_s=fleet_hbm)
     print(format_report(rep), file=sys.stderr, flush=True)
     _write_telemetry({"metric": metric, "wallclock_s": elapsed,
                       "devices": _ndev(), "degraded": _degraded_run(rep),

@@ -276,6 +276,25 @@ SERVICE_WORKERS_ENV = "MPLC_TPU_SERVICE_WORKERS"
 SERVICE_PRIORITY_DEFAULT_ENV = "MPLC_TPU_SERVICE_PRIORITY_DEFAULT"
 SERVICE_SHED_P99_ENV = "MPLC_TPU_SERVICE_SHED_P99_SEC"
 
+# Device-time accounting (mplc_tpu/obs/devcost.py):
+#   MPLC_TPU_DEVICE_FENCE_RATE     fraction of device batches that run
+#                                  FENCED: the engine drains any
+#                                  in-flight overlap first, dispatches
+#                                  the sampled batch alone, and times a
+#                                  host fetch of its results — a true
+#                                  device-step-seconds sample (host
+#                                  fetch, not block_until_ready: the
+#                                  axon tunnel does not reliably sync
+#                                  the latter). Deterministic by batch
+#                                  ordinal (every round(1/rate)-th
+#                                  batch), so runs replay identically.
+#                                  Default 1/16; 0 = off. Fencing NEVER
+#                                  changes v(S) (equality-tested) — it
+#                                  only moves harvest points — but it is
+#                                  a workload knob: the added syncs
+#                                  reshape measured wall-clock.
+DEVICE_FENCE_RATE_ENV = "MPLC_TPU_DEVICE_FENCE_RATE"
+
 # Live telemetry plane (mplc_tpu/obs/export.py + flight.py + chrome_trace):
 #   MPLC_TPU_METRICS_PORT          when set, one stdlib HTTP daemon thread
 #                                  serves /metrics (Prometheus text),
@@ -299,7 +318,20 @@ SERVICE_SHED_P99_ENV = "MPLC_TPU_SERVICE_SHED_P99_SEC"
 #                                  (requires MPLC_TPU_TRACE_FILE); the
 #                                  offline equivalent is
 #                                  scripts/trace_to_perfetto.py
+#   MPLC_TPU_METRICS_TOKEN         optional bearer token for the
+#                                  telemetry endpoints: when set,
+#                                  /metrics and /varz require
+#                                  `Authorization: Bearer <token>`
+#                                  (401 otherwise; /healthz stays open
+#                                  for liveness probes) and the /varz
+#                                  per-job table is tenant-REDACTED —
+#                                  rows belonging to tenants other than
+#                                  the `?tenant=` viewer keep only
+#                                  status/priority/age under a hashed
+#                                  tenant tag. Unset = the loopback
+#                                  default behavior, unchanged.
 METRICS_PORT_ENV = "MPLC_TPU_METRICS_PORT"
+METRICS_TOKEN_ENV = "MPLC_TPU_METRICS_TOKEN"
 FLIGHT_RECORDER_DIR_ENV = "MPLC_TPU_FLIGHT_RECORDER_DIR"
 FLIGHT_RECORDER_SIZE_ENV = "MPLC_TPU_FLIGHT_RECORDER_SIZE"
 CHROME_TRACE_ENV = "MPLC_TPU_CHROME_TRACE_FILE"
@@ -366,7 +398,13 @@ ENV_KNOBS = {
     "MPLC_TPU_STEP_WIDTH_MULT": "workload",
     "MPLC_TPU_SYNTH_NOISE": "workload",
     "MPLC_TPU_SYNTH_SCALE": "workload",
+    # workload, not sidecar: a fenced batch is dispatched without
+    # overlap and synced through a host fetch — the sampling reshapes
+    # measured wall-clock (never v(S)), so a cached TPU number from a
+    # different fence rate is a different measurement protocol
+    "MPLC_TPU_DEVICE_FENCE_RATE": "workload",
     "MPLC_TPU_PROFILE_DIR": "sidecar",
+    "MPLC_TPU_METRICS_TOKEN": "sidecar",
     "MPLC_TPU_TRACE_FILE": "sidecar",
     # the live telemetry plane is pure observability plumbing: none of it
     # changes what a sweep computes or pays for, but all of it must be

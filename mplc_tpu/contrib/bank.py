@@ -52,6 +52,7 @@ import time
 import jax
 
 from .. import constants
+from ..obs import devcost
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 
@@ -99,10 +100,15 @@ def bank_stats() -> dict:
         keys = [str(k) for k in _PROGRAMS]
         failed = sum(1 for v in _PROGRAMS.values()
                      if not isinstance(v, dict))
+        costed = sum(1 for v in _PROGRAMS.values()
+                     if isinstance(v, dict) and v.get("cost"))
         return {
             "enabled": bank_enabled(),
             "programs": len(keys),
             "failed_compiles": failed,
+            # bundles whose compile exposed XLA cost analysis (the
+            # roofline/metering input; see obs/devcost.py)
+            "costed_programs": costed,
             "inflight": len(_INFLIGHT),
             "max_programs": _MAX_PROGRAMS,
             "manifest_dir": manifest_dir(),
@@ -256,11 +262,32 @@ class ProgramBank:
         result (bundle or the failure) to the global store."""
         t0 = time.perf_counter()
         entry = None
+        cost = None
         ok = False
         try:
             try:
                 entry = self._compile_bundle(pipe, slot_count, width)
                 ok = True
+                # XLA cost truth: harvest the compiled executables' cost
+                # analysis (flops / bytes accessed / transcendentals) at
+                # compile time — the engine stamps it onto every batch
+                # the bundle runs, and the report derives the roofline
+                # row from it. None (no cost analysis on this backend /
+                # executable) degrades to the analytic proxy downstream.
+                # Harvested AFTER ok=True and under its own guard: an
+                # observability failure (an exotic cost-analysis schema)
+                # must never discard a successfully compiled bundle as a
+                # "failed compile".
+                try:
+                    cost = devcost.bundle_cost(entry)
+                except Exception as ce:
+                    cost = None
+                    logger.warning(
+                        "program-bank cost analysis failed for "
+                        "(slots=%s, width=%s) — bundle banked without "
+                        "cost truth: %s", slot_count, width, ce)
+                if cost is not None:
+                    entry["cost"] = cost
             except Exception as e:  # a bad lowering must not kill the sweep
                 logger.warning(
                     "program-bank compile failed for (slots=%s, width=%s) — "
@@ -287,11 +314,14 @@ class ProgramBank:
             obs_metrics.counter("bank.compile_seconds").inc(dur)
             if overlapped:
                 obs_metrics.counter("bank.compiles_overlapped").inc()
+            extra = ({"flops": cost["flops"],
+                      "bytes_accessed": cost["bytes_accessed"]}
+                     if cost else {})
             obs_trace.event(
                 "bank.compile", dur=dur, slot_count=slot_count,
                 width=int(width), overlapped=overlapped,
-                donation=self._pipe_donates(pipe), programs=3)
-            self._record_manifest(key)
+                donation=self._pipe_donates(pipe), programs=3, **extra)
+            self._record_manifest(key, cost)
 
     def _claim(self, key):
         """(entry, event, owner): the published entry if any, else the
@@ -373,34 +403,54 @@ class ProgramBank:
 
     # -- persistence (the manifest that makes the cache dir a bank) ------
 
-    def persistent_keys(self) -> set:
+    def _manifest_doc(self) -> dict:
         d = manifest_dir()
         if not d:
-            return set()
+            return {}
         try:
             with open(os.path.join(d, MANIFEST_NAME)) as f:
-                return set(json.load(f).get("programs", []))
+                doc = json.load(f)
+            return doc if isinstance(doc, dict) else {}
         except (OSError, ValueError):
-            return set()
+            return {}
 
-    def _record_manifest(self, key: str) -> None:
-        """Append a compiled program's key to the cache-dir manifest
-        (atomic replace; lost manifests only cost a warm-up, never
-        correctness — the XLA cache itself is content-addressed)."""
+    def persistent_keys(self) -> set:
+        return set(self._manifest_doc().get("programs", []))
+
+    def persistent_costs(self) -> dict:
+        """key -> {"flops", "bytes_accessed", "transcendentals"} for every
+        manifest program whose compile exposed XLA cost analysis —
+        pre-cost manifests simply have no `costs` block, so an operator
+        (or /varz) can query a cache dir's program costs without
+        compiling anything."""
+        return dict(self._manifest_doc().get("costs", {}))
+
+    def _record_manifest(self, key: str,
+                         cost: "dict | None" = None) -> None:
+        """Append a compiled program's key (and its XLA cost analysis,
+        when available) to the cache-dir manifest (atomic replace; lost
+        manifests only cost a warm-up, never correctness — the XLA cache
+        itself is content-addressed). Pre-cost manifests are upgraded in
+        place: the `programs` list is untouched, a `costs` block grows
+        beside it."""
         d = manifest_dir()
         if not d:
             return
         with _MANIFEST_LOCK:
-            keys = self.persistent_keys()
-            if key in keys:
+            doc = self._manifest_doc()
+            keys = set(doc.get("programs", []))
+            costs = dict(doc.get("costs", {}))
+            if key in keys and (cost is None or key in costs):
                 return
             keys.add(key)
+            if cost is not None:
+                costs[key] = cost
             path = os.path.join(d, MANIFEST_NAME)
             tmp = f"{path}.tmp"
             try:
                 os.makedirs(d, exist_ok=True)
                 with open(tmp, "w") as f:
-                    json.dump({"programs": sorted(keys)}, f)
+                    json.dump({"programs": sorted(keys), "costs": costs}, f)
                 os.replace(tmp, path)
             except OSError as e:
                 logger.warning("program-bank manifest write failed: %s", e)

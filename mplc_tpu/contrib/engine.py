@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import constants, faults
+from ..obs import devcost
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..data.partition import StackedPartners, stack_eval_set
@@ -221,6 +222,13 @@ class BatchedTrainerPipeline:
             return (np.asarray(jax.device_get(accs)),
                     np.asarray(jax.device_get(epochs_done)))
 
+        # device-fence capability (obs/devcost.py): force the batch's
+        # small result arrays to the host NOW — a reliable "this batch's
+        # device work is done" sync (the axon tunnel does not reliably
+        # honor block_until_ready, so a host fetch is the fence). The
+        # later harvest() re-fetch of the tiny arrays is noise.
+        harvest.block = lambda: (jax.device_get(accs),
+                                 jax.device_get(epochs_done))
         return harvest
 
 
@@ -313,6 +321,10 @@ class CharacteristicEngine:
     _partner_faults: dict = {}
     _forever_dropped: frozenset = frozenset()
     program_bank = None
+    # device-time accounting defaults (obs/devcost.py): engine doubles
+    # that bypass __init__ run unfenced and unmetered
+    device_meter = None
+    _fence_interval = 0
     # set when a legacy (pre-checksum) cache was loaded: the next
     # save_cache to that file rewrites it in the integrity format
     _cache_needs_upgrade = False
@@ -592,6 +604,16 @@ class CharacteristicEngine:
         # fails once, then its bit-identical retry goes through".
         self._batch_ordinal = 0
         self._faults = faults.FaultInjector.from_env()
+
+        # Sampled device fences + the device-seconds meter
+        # (obs/devcost.py, MPLC_TPU_DEVICE_FENCE_RATE): every
+        # `_fence_interval`-th batch ordinal is dispatched with the
+        # pipeline overlap drained and its results host-fetched
+        # immediately — a true device-step-seconds sample. Deterministic
+        # in the ordinal, so runs replay; never changes v(S) (only the
+        # harvest point moves — equality-tested in tests/test_devcost.py).
+        self._fence_interval = devcost.fence_interval()
+        self.device_meter = devcost.DeviceMeter(self._fence_interval)
 
         self._sharding = coalition_sharding()
 
@@ -895,6 +917,46 @@ class CharacteristicEngine:
                 self.single_pipe.trainer, b)
         return self._singles_pipes[b]
 
+    def _maybe_fence(self, fetch, meta) -> None:
+        """Sampled device fence (obs/devcost.py): when `meta["ordinal"]`
+        is a fence ordinal, time a host fetch of the just-dispatched
+        batch's results — the true device-step seconds behind the
+        report's device row and the service's device-seconds metering.
+        The caller drained any in-flight overlap first, so the sample
+        times ONLY this batch. Never raises: a failing fetch here leaves
+        the error to the harvest ladder (which re-dispatches/retries
+        bit-identically), and the sample is simply not taken."""
+        if not devcost.should_fence(meta.get("ordinal", 0),
+                                    self._fence_interval):
+            return
+        block = getattr(fetch, "block", None)
+        if block is None:
+            return  # stubbed pipes (tests) have no fence capability
+        t0 = time.perf_counter()
+        try:
+            block()
+        except Exception:
+            return  # the harvest ladder owns failures
+        dur = time.perf_counter() - t0
+        meta["device_sec"] = dur
+        obs_metrics.histogram("engine.device_step_sec").observe(dur)
+        obs_trace.event("engine.device_fence", dur=dur,
+                        ordinal=meta.get("ordinal"), width=meta["width"],
+                        slot_count=meta.get("slot_count"),
+                        coalitions=meta["coalitions"],
+                        interval=self._fence_interval)
+
+    def _fence_next(self, pending) -> bool:
+        """True when the NEXT batch ordinal is a fence sample and an
+        in-flight batch must be drained first (so the fence times only
+        its own batch). The prediction can go stale when a recovery
+        path dispatches extra batches inside the drain — the worst case
+        is one un-drained (slightly inflated) or one extra-drained
+        sample, never a correctness issue."""
+        return (pending is not None and self._fence_interval
+                and devcost.should_fence(self._batch_ordinal + 1,
+                                         self._fence_interval))
+
     def _retry_transient(self, op, site: str, ordinal: "int | None" = None):
         """Run `op` with bounded exponential backoff on transient runtime
         failures (`faults.is_transient`): up to MPLC_TPU_MAX_RETRIES
@@ -1144,6 +1206,13 @@ class CharacteristicEngine:
                                          pipe, slot_count, per_partner,
                                          passes_per_mb, seed_rows=seed_rows)
                     return
+                if self._fence_next(pending):
+                    # a fenced ordinal must time ONLY its own batch:
+                    # drain the in-flight one first (values unaffected —
+                    # only the harvest point moves)
+                    prev, pending = pending, None
+                    self._record_or_recover(prev, per_partner,
+                                            slot_count, pipe)
                 if self._cap_halvings != halvings_seen:
                     # an OOM (here or inside a harvest recovery) stepped the
                     # ladder down: re-bucket the REMAINING subsets through
@@ -1167,6 +1236,14 @@ class CharacteristicEngine:
                         "mb_count": pipe.trainer.cfg.minibatch_count,
                         "ordinal": self._batch_ordinal,
                         "ensemble": K > 1}
+                # XLA-modeled cost of one bundle execution (init+run+fin
+                # — exactly this batch), stamped from the banked
+                # executables; inline-jit batches carry no cost and the
+                # report falls back to the analytic proxy
+                cost = (exes.get("cost") if exes else None) or {}
+                if cost.get("flops"):
+                    meta["flops"] = cost["flops"]
+                    meta["bytes_accessed"] = cost.get("bytes_accessed")
 
                 def dispatch(sel=sel, attrs=attrs,
                              ordinal=self._batch_ordinal, exes=exes):
@@ -1223,6 +1300,7 @@ class CharacteristicEngine:
                         # shard_map programs need the mesh
                         raise self._ladder_exhausted(e) from e
                     continue
+                self._maybe_fence(fetch, meta)
                 i += len(group)
                 if overlap:
                     # harvest the PREVIOUS batch only after this one is in
@@ -1306,6 +1384,12 @@ class CharacteristicEngine:
             meta["redispatch"] = dispatch
             fetch = self._retry_transient(
                 dispatch, "dispatch", meta["ordinal"])
+            # NO fence on the CPU rung: a CPU-rung sample is orders of
+            # magnitude slower than a device one, and a mixed run's
+            # fenced extrapolation (and per-tenant billing) would blend
+            # the two rates. The rung is synchronous anyway — its host
+            # span IS its compute time, and the meter bills it in its
+            # own degraded class (obs/devcost.py).
             self._record_group(group, fetch, len(jobs) - i, meta,
                                per_partner, slot_count)
 
@@ -1366,13 +1450,30 @@ class CharacteristicEngine:
             obs_metrics.counter("engine.cpu_degraded_batches").inc()
             obs_metrics.counter("engine.cpu_degraded_coalitions").inc(
                 len(group))
+        if meta.get("device_sec") is not None:
+            # this batch ran fenced: its measured device-step seconds
+            # ride the event into the report's device/roofline rows
+            extra["fenced"] = True
+            extra["device_sec"] = meta["device_sec"]
+        if meta.get("flops"):
+            extra["flops"] = meta["flops"]
+            if meta.get("bytes_accessed"):
+                extra["bytes_accessed"] = meta["bytes_accessed"]
+        dur = time.perf_counter() - meta["t0"]
         obs_trace.event(
-            "engine.batch", dur=time.perf_counter() - meta["t0"],
+            "engine.batch", dur=dur,
             width=meta["width"], slot_count=slot_count,
             ordinal=meta.get("ordinal"),
             coalitions=meta["coalitions"], padding=meta["padding"],
             epochs=batch_epochs, samples=batch_samples,
             partner_passes=batch_passes, **extra)
+        if self.device_meter is not None:
+            self.device_meter.note(
+                len(group), span_sec=dur,
+                device_sec=meta.get("device_sec"),
+                flops=meta.get("flops"),
+                bytes_accessed=meta.get("bytes_accessed"),
+                degraded=bool(meta.get("degraded")))
         obs_metrics.counter("engine.epochs_trained").inc(batch_epochs)
         obs_metrics.counter("engine.samples_trained").inc(batch_samples)
         obs_metrics.counter("engine.partner_passes").inc(batch_passes)
@@ -1456,6 +1557,12 @@ class CharacteristicEngine:
         try:
             i = 0
             while i < len(singles):
+                if self._fence_next(pending):
+                    # same pre-drain rule as _run_batch: a fenced
+                    # ordinal times only its own batch
+                    prev, pending = pending, None
+                    if harvest_prev(prev):
+                        return
                 group = singles[i:i + b]
                 sel = np.full(b, i, np.intp)
                 sel[:len(group)] = np.arange(i, i + len(group))
@@ -1497,6 +1604,7 @@ class CharacteristicEngine:
                             return
                     recover_oom(e)
                     return
+                self._maybe_fence(fetch, meta)
                 if overlap:
                     if pending is not None:
                         prev, pending = pending, None

@@ -186,9 +186,12 @@ def record_updates(engine) -> RecordedRun:
     obs_metrics.counter("engine.epochs_trained").inc(epochs)
     obs_metrics.counter("engine.samples_trained").inc(samples)
     obs_metrics.counter("engine.partner_passes").inc(passes)
-    obs_trace.event("engine.batch", dur=time.perf_counter() - t0, width=1,
+    rec_dur = time.perf_counter() - t0
+    obs_trace.event("engine.batch", dur=rec_dur, width=1,
                     slot_count=None, coalitions=1, padding=0, epochs=epochs,
                     samples=samples, partner_passes=passes, recording=True)
+    if engine.device_meter is not None:
+        engine.device_meter.note(1, span_sec=rec_dur)
     for k, v in rec.describe().items():
         span.attrs[k] = v
     span.end()
@@ -409,10 +412,17 @@ class ReconstructionEvaluator:
             # eval-only batch: zero epochs / samples / partner passes — the
             # sweep report's reconstruction row derives the eval-vs-train
             # split from exactly this shape
-            obs_trace.event("engine.batch",
-                            dur=time.perf_counter() - meta["t0"], width=b,
+            dur = time.perf_counter() - meta["t0"]
+            obs_trace.event("engine.batch", dur=dur, width=b,
                             slot_count=slot_count, coalitions=len(group),
                             padding=b - len(group), epochs=0, samples=0,
                             partner_passes=0, eval_only=True, **extra)
+            if eng.device_meter is not None:
+                # reconstruction batches carry no fence/cost sample (the
+                # fused eval is inline-jit); eval_only keeps them out of
+                # the fenced-training-rate extrapolation — they bill at
+                # their own host span
+                eng.device_meter.note(len(group), span_sec=dur,
+                                      eval_only=True)
             if eng.progress is not None:
                 eng.progress(len(group), n - i, slot_count)

@@ -9,7 +9,9 @@ https://ui.perfetto.dev loads directly:
   - every record becomes a complete ("X") slice on a per-thread track
     (`pid` 1, `tid` = the recording thread id, named via "M" metadata
     events); zero-duration events are widened to 1 us so they render and
-    can anchor flows;
+    can anchor flows. Sampled device fences (`engine.device_fence`,
+    obs/devcost.py) get their own "device" process track (pid 2), so
+    measured device time separates visually from host spans;
   - timestamps are rebased to the trace's first record and expressed in
     microseconds (the format's unit);
   - FLOW events (ph "s"/"f") draw arrows linking the recovery machinery
@@ -42,6 +44,12 @@ CHROME_TRACE_ENV = "MPLC_TPU_CHROME_TRACE_FILE"
 _FLOW_SOURCES = {"engine.retry": "retry", "engine.fault": "fault",
                  "engine.degrade": "degrade",
                  "service.job_fault": "requeue"}
+
+# records that represent MEASURED DEVICE time (the sampled fences,
+# obs/devcost.py) rather than host-side spans: drawn on their own
+# "device" process track (pid 2) so the enqueue-vs-device-vs-harvest
+# split the report totals is visually inspectable on the timeline
+_DEVICE_ROWS = {"engine.device_fence"}
 
 
 def read_jsonl(path: str) -> tuple[list, int]:
@@ -78,22 +86,23 @@ def to_chrome(records: list) -> dict:
     else:
         t0 = 0.0
 
-    tids = []
+    tids = []  # (pid, tid) in file-discovery order
     slices = []  # (rec, ts_us, dur_us) in file order, for flow targets
     for rec in records:
         tid = int(rec.get("thread") or 0)
-        if tid not in tids:
-            tids.append(tid)
+        name = rec.get("name", "?")
+        pid = 2 if name in _DEVICE_ROWS else 1
+        if (pid, tid) not in tids:
+            tids.append((pid, tid))
         ts_us = (float(rec.get("ts") or 0.0) - t0) * 1e6
         dur_us = max(float(rec.get("dur") or 0.0) * 1e6, 1.0)
-        name = rec.get("name", "?")
         events.append({
             "name": name,
             "cat": name.split(".", 1)[0],
             "ph": "X",
             "ts": ts_us,
             "dur": dur_us,
-            "pid": 1,
+            "pid": pid,
             "tid": tid,
             "args": {**_attrs(rec), "span_id": rec.get("id"),
                      "parent_span": rec.get("parent")},
@@ -101,11 +110,17 @@ def to_chrome(records: list) -> dict:
         slices.append((rec, ts_us, dur_us))
 
     # thread tracks: name them, keep file-discovery order stable
-    for i, tid in enumerate(tids):
-        events.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": 1,
-                       "tid": tid, "args": {"name": f"thread-{tid}"}})
+    for i, (pid, tid) in enumerate(tids):
+        prefix = "device" if pid == 2 else "thread"
+        events.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
+                       "tid": tid, "args": {"name": f"{prefix}-{tid}"}})
         events.append({"name": "thread_sort_index", "ph": "M", "ts": 0,
-                       "pid": 1, "tid": tid, "args": {"sort_index": i}})
+                       "pid": pid, "tid": tid, "args": {"sort_index": i}})
+    if any(pid == 2 for pid, _ in tids):
+        events.append({"name": "process_name", "ph": "M", "ts": 0, "pid": 1,
+                       "tid": 0, "args": {"name": "host"}})
+        events.append({"name": "process_name", "ph": "M", "ts": 0, "pid": 2,
+                       "tid": 0, "args": {"name": "device (fenced samples)"}})
 
     flows = _flow_events(slices)
     events.extend(flows)

@@ -1,0 +1,357 @@
+"""Device-time attribution and XLA cost accounting.
+
+Everything the obs layer measured before this module is HOST wall-clock:
+JAX dispatch is asynchronous, so an `engine.dispatch` span times enqueue,
+an `engine.batch` duration times dispatch-start → harvest-end (which
+under batch pipelining overlaps its neighbors), and the compute row's
+`mfu_proxy` rests on the hand-derived `models/zoo.fwd_flops_per_sample`
+estimate. This module supplies the three device-side primitives the rest
+of the observability plane builds on:
+
+  1. **XLA cost truth** — `cost_analysis(compiled)` /
+     `bundle_cost(bundle)` harvest `Compiled.cost_analysis()` (flops,
+     bytes accessed, transcendentals) from AOT-compiled executables at
+     compile time. The program bank (contrib/bank.py) attaches the cost
+     to every bundle and persists it in its manifest; `engine.batch`
+     events then carry per-batch modeled flops/bytes, and the sweep
+     report derives a per-program ROOFLINE row (achieved FLOP/s vs peak,
+     bytes/s vs HBM bandwidth, arithmetic intensity) plus an XLA-derived
+     `mfu_xla` that supersedes the analytic proxy when available.
+     Backends/executables without cost analysis (some CPU builds, the
+     OOM-rebucketed inline-jit fallback widths) degrade to None and the
+     report falls back to the analytic proxy — schema unchanged.
+
+  2. **Sampled device fences** — `fence_interval()` parses
+     `MPLC_TPU_DEVICE_FENCE_RATE` (default 1/16; 0 = off) into a batch-
+     ordinal stride and `should_fence(ordinal, interval)` decides
+     deterministically, so a replayed run fences the same batches. A
+     fenced batch is dispatched with the pipeline overlap drained and
+     its results are fetched to the host immediately (a host fetch, not
+     `block_until_ready` — the axon tunnel does not reliably sync the
+     latter), timing a true device-step-seconds sample
+     (`engine.device_step_sec` histogram, `engine.device_fence` event).
+     Fencing never changes v(S): it only moves harvest points
+     (equality-tested in tests/test_devcost.py, fault ladder included).
+
+  3. **Device-seconds metering** — `DeviceMeter` accumulates per-engine
+     batch accounting (coalitions, host span, fenced seconds, modeled
+     flops) and `estimate_device_seconds(delta, peak)` turns a delta of
+     it into billable device-seconds with an explicit BASIS:
+     `"fenced"` (fenced samples extrapolated over all coalitions:
+     sec/coalition × coalitions), `"cost_model"` (XLA flops / fleet
+     peak — used when fences are off), `"host_span"` (the old
+     span-seconds, the last resort), `"none"`. The sweep service bills
+     each scheduling quantum's delta to the owning tenant
+     (`service.device_seconds{tenant=...}`), journals the meter with job
+     terminals, and switches the report's `cost_share` to device-seconds
+     (span-seconds kept as `host_share`).
+
+Chip tables (public Google Cloud TPU spec figures) provide bf16 peak
+FLOP/s and HBM bandwidth per chip for the roofline axes; unknown kinds
+(including host CPU) return None and every derived cell degrades to
+"n/a" rather than inventing a number.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from .. import constants
+
+logger = logging.getLogger("mplc_tpu")
+
+# sample ~1 batch in 16 by default: one extra sync per 16 batches is
+# noise next to a training batch, and small sweeps still get a sample
+# (ordinal 1 is always fenced when fencing is on)
+DEFAULT_FENCE_RATE = 1.0 / 16.0
+
+# bf16 peak FLOP/s per chip — Google Cloud TPU public spec pages
+# (v4 275 TFLOP/s, v5e 197, v5p 459, v6e/Trillium 918)
+_PEAK_FLOPS_BF16 = {
+    "tpu v5 lite": 197e12, "tpu v5e": 197e12, "tpu v5p": 459e12,
+    "tpu v4": 275e12, "tpu v6 lite": 918e12, "tpu v6e": 918e12,
+}
+# HBM bandwidth, bytes/s per chip — same spec pages (v4 1.2 TB/s,
+# v5e 0.82 TB/s, v5p 2.77 TB/s, v6e 1.64 TB/s)
+_HBM_BYTES_PER_S = {
+    "tpu v5 lite": 819e9, "tpu v5e": 819e9, "tpu v5p": 2765e9,
+    "tpu v4": 1228e9, "tpu v6 lite": 1640e9, "tpu v6e": 1640e9,
+}
+
+
+# -- sampled device fences ----------------------------------------------------
+
+def fence_interval(rate: "float | None" = None) -> int:
+    """The batch-ordinal stride of the device-fence sampler: 0 = fencing
+    off, else every `interval`-th batch (ordinal 1 included) runs fenced.
+    `rate` defaults to `MPLC_TPU_DEVICE_FENCE_RATE` (warn+fallback parse,
+    same contract as every other engine knob); rates above 1 clamp to
+    fence-every-batch."""
+    if rate is None:
+        rate = constants._env_nonneg_float(
+            constants.DEVICE_FENCE_RATE_ENV, DEFAULT_FENCE_RATE)
+    if rate <= 0:
+        return 0
+    return max(1, int(round(1.0 / min(rate, 1.0))))
+
+
+def should_fence(ordinal: int, interval: int) -> bool:
+    """Deterministic sampling decision for 1-based batch `ordinal`: pure
+    in (ordinal, interval), so a replayed run — any retry/recovery
+    schedule included — fences the same ordinals. Ordinal 1 is always a
+    sample when fencing is on (short runs still measure something)."""
+    return bool(interval) and ordinal % interval == 1 % interval
+
+
+# -- XLA cost harvesting ------------------------------------------------------
+
+def cost_analysis(compiled) -> "dict | None":
+    """`{"flops", "bytes_accessed", "transcendentals"}` floats from a
+    `Compiled.cost_analysis()`, or None when the backend/executable does
+    not expose it (older runtimes, some fallback paths). Tolerates both
+    the list-wrapped (one dict per partition) and bare-dict forms and
+    missing keys: `flops` is required for the result to be useful, the
+    other fields degrade to 0.0."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = ca.get("flops")
+    if flops is None:
+        return None
+    try:
+        return {
+            "flops": float(flops),
+            # XLA's key has a space; normalize for JSON/attr consumers
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0) or 0.0),
+            "transcendentals": float(ca.get("transcendentals", 0.0)
+                                     or 0.0),
+        }
+    except (TypeError, ValueError):
+        # an exotic cost-analysis schema (non-numeric values) degrades
+        # to "no cost truth", never to an exception in the compile path
+        return None
+
+
+def bundle_cost(bundle: dict) -> "dict | None":
+    """Summed cost analysis of a program-bank bundle's executables
+    (init + run + fin = exactly one batch execution; the epoch-chunk
+    `run` dominates). None when NO executable exposes flops — a partial
+    bundle (e.g. only `run` costed) still yields the partial sum, which
+    is the conservative direction for an achieved-FLOP/s figure."""
+    total = {"flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0}
+    any_cost = False
+    for name in ("init", "run", "fin"):
+        c = bundle.get(name)
+        if c is None:
+            continue
+        cost = cost_analysis(c)
+        if cost is None:
+            continue
+        any_cost = True
+        for k in total:
+            total[k] += cost[k]
+    return total if any_cost else None
+
+
+# -- chip tables --------------------------------------------------------------
+
+def peak_flops_per_chip(device_kind: str) -> "float | None":
+    """bf16 peak FLOP/s of one chip by `device_kind` substring match;
+    None for unknown kinds and host CPU (no peak = no MFU, never a
+    made-up denominator)."""
+    kind = (device_kind or "").lower()
+    for k, v in _PEAK_FLOPS_BF16.items():
+        if k in kind:
+            return v
+    return None
+
+
+def hbm_bytes_per_s_per_chip(device_kind: str) -> "float | None":
+    """HBM bandwidth (bytes/s) of one chip; None when unknown."""
+    kind = (device_kind or "").lower()
+    for k, v in _HBM_BYTES_PER_S.items():
+        if k in kind:
+            return v
+    return None
+
+
+# the fleet is fixed for the process lifetime, and the scheduler asks at
+# every quantum billing — memoize so metering never re-queries the
+# backend (jax.devices() can cross a tunnel on remote backends)
+_FLEET_CACHE: dict = {}
+
+
+def fleet_peak_flops() -> "float | None":
+    """The attached fleet's aggregate bf16 peak (per-chip peak × device
+    count), or None on unknown chips / host CPU / no importable jax.
+    Memoized per process."""
+    if "peak" not in _FLEET_CACHE:
+        try:
+            import jax
+            devs = jax.devices()
+            peak = peak_flops_per_chip(devs[0].device_kind)
+            _FLEET_CACHE["peak"] = peak * len(devs) if peak else None
+        except Exception:
+            _FLEET_CACHE["peak"] = None
+    return _FLEET_CACHE["peak"]
+
+
+def fleet_hbm_bytes_per_s() -> "float | None":
+    """Aggregate HBM bandwidth of the attached fleet, or None.
+    Memoized per process."""
+    if "hbm" not in _FLEET_CACHE:
+        try:
+            import jax
+            devs = jax.devices()
+            bw = hbm_bytes_per_s_per_chip(devs[0].device_kind)
+            _FLEET_CACHE["hbm"] = bw * len(devs) if bw else None
+        except Exception:
+            _FLEET_CACHE["hbm"] = None
+    return _FLEET_CACHE["hbm"]
+
+
+# -- the device-seconds meter -------------------------------------------------
+
+_METER_FIELDS = ("batches", "coalitions", "span_sec", "fenced_batches",
+                 "fenced_coalitions", "fenced_sec", "flops",
+                 "bytes_accessed", "costed_coalitions",
+                 "eval_coalitions", "eval_span_sec",
+                 "degraded_coalitions", "degraded_span_sec")
+
+# billing-basis trust order, best first
+_BASIS_RANK = ("fenced", "cost_model", "host_span", "none")
+
+
+class DeviceMeter:
+    """Per-engine device-time accounting: every harvested batch notes its
+    coalition count and host span, fenced batches add their measured
+    device seconds, and bank-served batches add their XLA-modeled
+    flops/bytes. Thread-safe (the service's worker pool bills deltas of
+    one engine from its owning worker, but /varz snapshots concurrently).
+    """
+
+    __slots__ = ("interval", "_lock") + _METER_FIELDS
+
+    def __init__(self, interval: int = 0):
+        self.interval = interval
+        self._lock = threading.Lock()
+        for f in _METER_FIELDS:
+            setattr(self, f, 0 if f not in ("span_sec", "fenced_sec",
+                                            "flops", "bytes_accessed",
+                                            "eval_span_sec",
+                                            "degraded_span_sec")
+                    else 0.0)
+
+    def note(self, coalitions: int, span_sec: float = 0.0,
+             device_sec: "float | None" = None,
+             flops: "float | None" = None,
+             bytes_accessed: "float | None" = None,
+             eval_only: bool = False, degraded: bool = False) -> None:
+        """One harvested batch's accounting (padding rows excluded from
+        `coalitions`, like every other throughput counter). `eval_only`
+        marks reconstruction batches (retrain-free estimators) and
+        `degraded` marks the OOM ladder's CPU-rung batches: both cost
+        wildly differently from a fenced device training batch (orders
+        of magnitude cheaper / slower respectively), so each is tracked
+        in its own class, billed at its own host span, and NEVER mixed
+        into the fenced training-rate extrapolation. (The CPU rung is
+        synchronous, so its host span IS its compute time.)"""
+        with self._lock:
+            self.batches += 1
+            self.coalitions += int(coalitions)
+            self.span_sec += float(span_sec)
+            if eval_only:
+                self.eval_coalitions += int(coalitions)
+                self.eval_span_sec += float(span_sec)
+            elif degraded:
+                self.degraded_coalitions += int(coalitions)
+                self.degraded_span_sec += float(span_sec)
+            if device_sec is not None:
+                self.fenced_batches += 1
+                self.fenced_coalitions += int(coalitions)
+                self.fenced_sec += float(device_sec)
+            if flops:
+                self.flops += float(flops)
+                self.bytes_accessed += float(bytes_accessed or 0.0)
+                self.costed_coalitions += int(coalitions)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {f: getattr(self, f) for f in _METER_FIELDS}
+
+    def device_seconds(self, peak_flops: "float | None" = None
+                       ) -> "tuple[float, str]":
+        """Lifetime (seconds, basis) — see `estimate_device_seconds`."""
+        return estimate_device_seconds(self.snapshot(), peak_flops)
+
+
+def meter_delta(before: dict, after: dict) -> dict:
+    """Field-wise `after - before` of two meter snapshots (the unit the
+    scheduler bills per quantum)."""
+    return {f: after.get(f, 0) - before.get(f, 0) for f in _METER_FIELDS}
+
+
+def estimate_device_seconds(totals: dict,
+                            peak_flops: "float | None" = None
+                            ) -> "tuple[float, str]":
+    """(device_seconds, basis) for a meter snapshot or delta.
+
+    Basis order — most to least trusted:
+      "fenced":      measured fenced seconds extrapolated over every
+                     TRAINING coalition (sec/coalition × train
+                     coalitions; the documented extrapolation rule —
+                     batch widths vary, so the per-coalition rate is
+                     the stable unit). Eval-only reconstruction
+                     coalitions (orders of magnitude cheaper) and
+                     CPU-degraded-rung coalitions (orders of magnitude
+                     slower, and synchronous) are billed at their own
+                     host span instead of the device training rate;
+      "cost_model":  XLA-modeled flops (scaled up for un-costed
+                     training coalitions by the same per-coalition
+                     rule) over the fleet's peak FLOP/s — an OPTIMISTIC
+                     lower bound (assumes peak-rate execution), used
+                     when fences are off and a peak figure exists;
+      "host_span":   summed per-batch host spans (dispatch→harvest) —
+                     the pre-devcost behavior, kept as the explicit
+                     last resort (over-counts under batch pipelining);
+      "none":        no signal at all (0.0 seconds).
+    """
+    coalitions = totals.get("coalitions", 0)
+    eval_c = totals.get("eval_coalitions", 0)
+    deg_c = totals.get("degraded_coalitions", 0)
+    # eval-only and CPU-degraded batches bill at their own (synchronous)
+    # host span — only clean device TRAINING coalitions ride the fenced
+    # or cost-model rate
+    extra = (totals.get("eval_span_sec", 0.0)
+             + totals.get("degraded_span_sec", 0.0))
+    train_c = coalitions - eval_c - deg_c
+    fenced_c = totals.get("fenced_coalitions", 0)
+    if fenced_c > 0 and train_c > 0:
+        per = totals.get("fenced_sec", 0.0) / fenced_c
+        return per * train_c + extra, "fenced"
+    flops = totals.get("flops", 0.0)
+    costed_c = totals.get("costed_coalitions", 0)
+    if flops > 0 and peak_flops:
+        scale = (train_c / costed_c) if costed_c and train_c > 0 else 1.0
+        return flops * scale / peak_flops + extra, "cost_model"
+    span = totals.get("span_sec", 0.0)
+    if span > 0:
+        return span, "host_span"
+    return 0.0, "none"
+
+
+def merge_basis(a: "str | None", b: "str | None") -> "str | None":
+    """The most-trusted basis either argument carries (a job whose
+    quanta billed under mixed bases reports the best one; the per-quantum
+    `service.slice` attrs keep the exact per-delta basis)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a if _BASIS_RANK.index(a) <= _BASIS_RANK.index(b) else b

@@ -28,9 +28,14 @@ with three routes:
 
 With the env var UNSET nothing happens: no socket, no thread — the
 instrumented paths cost exactly what they cost before. A plain port
-value binds LOOPBACK only (the endpoints are unauthenticated — tenant
-names, job tables, error strings); `host:port` opts into wider exposure
-explicitly. Port 0 binds an ephemeral port (tests; the bound port is on
+value binds LOOPBACK only (the endpoints are unauthenticated by
+default — tenant names, job tables, error strings); `host:port` opts
+into wider exposure explicitly. Setting `MPLC_TPU_METRICS_TOKEN`
+additionally gates /metrics and /varz behind bearer credentials — the
+master token for the operator, `tenant_token(master, name)`-derived
+credentials for tenants, whose /varz view has every other tenant's rows
+redacted (`redact_varz`) — the first concrete step toward
+mutually-distrusting consortium tenants sharing one telemetry plane. Port 0 binds an ephemeral port (tests; the bound port is on
 `TelemetryServer.port` and in the start-up log line). The server is a
 process singleton: the first `start()` wins, later calls return it.
 
@@ -42,6 +47,8 @@ not be takeable-down by the thing it observes.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import http.server
 import json
 import logging
@@ -49,6 +56,7 @@ import os
 import re
 import threading
 import time
+import urllib.parse
 import warnings
 import weakref
 
@@ -57,6 +65,17 @@ from . import metrics
 logger = logging.getLogger("mplc_tpu")
 
 METRICS_PORT_ENV = "MPLC_TPU_METRICS_PORT"
+# Optional bearer token (first step of the ROADMAP secure-contributivity
+# item): when set, /metrics and /varz require `Authorization: Bearer`
+# credentials (401 otherwise; /healthz stays open for liveness probes).
+# The master token is the OPERATOR credential (full unredacted view; the
+# only credential /metrics accepts — Prometheus text has no redacted
+# rendering); `tenant_token(master, name)` derives one per-tenant
+# credential which, presented with `?tenant=<name>`, unlocks /varz with
+# every OTHER tenant's rows redacted under HMAC-keyed tags — the viewer
+# claim is authenticated, never self-declared. Unset = the loopback
+# default behavior, unchanged.
+METRICS_TOKEN_ENV = "MPLC_TPU_METRICS_TOKEN"
 
 _lock = threading.Lock()
 _server: "TelemetryServer | None" = None
@@ -134,6 +153,149 @@ def varz_view() -> dict:
     return out
 
 
+# -- tenant credentials + redaction -------------------------------------------
+
+# the per-job table key a redaction walk recognizes, and the row fields a
+# non-viewer is still allowed to see (scheduling facts, no identity/work
+# detail — enough to reason about queue fairness, nothing about the game)
+_REDACTED_ROW_FIELDS = ("status", "priority", "age_sec")
+# greedy to the closing brace: a tenant name containing ',' (legal in the
+# registry's `name{tenant=...}` keys, which join label pairs with commas)
+# must hash in FULL — swallowing a trailing label into the hash
+# over-redacts, which is the safe direction; leaking the remainder is not
+_TENANT_LABEL_RE = re.compile(r"tenant=([^}]*)")
+
+
+def tenant_token(master: str, tenant) -> str:
+    """The per-tenant bearer credential: HMAC-SHA256(master, tenant).
+
+    A single shared token cannot carry a tenant identity — anyone
+    holding it could claim any `?tenant=` and read every other tenant's
+    rows. Instead the operator (who holds the master
+    `MPLC_TPU_METRICS_TOKEN`) derives one credential per tenant with
+    this function and hands each tenant ITS token only: presenting
+    `Bearer <tenant_token>` together with `?tenant=<name>` authenticates
+    the viewer claim (a tenant cannot forge another tenant's HMAC
+    without the master), while the master itself is the operator
+    credential with the full, unredacted view."""
+    return hmac.new(master.encode(), str(tenant).encode(),
+                    hashlib.sha256).hexdigest()
+
+
+def _opaque_tag(value, key: "str | None" = None,
+                prefix: str = "tenant") -> str:
+    """Stable opaque tag for a redacted identifier (same input -> same
+    tag within and across snapshots, so a viewer can still correlate
+    rows without learning the identity). With `key` (the master token,
+    supplied by the HTTP handler) the tag is HMAC-keyed, so a viewer
+    cannot dictionary-confirm candidate names offline; the unkeyed
+    fallback is for direct redact_varz() callers."""
+    if key:
+        digest = hmac.new(key.encode(), str(value).encode(),
+                          hashlib.sha256).hexdigest()
+    else:
+        digest = hashlib.sha256(str(value).encode()).hexdigest()
+    return f"{prefix}-" + digest[:8]
+
+
+def _tenant_tag(tenant, key: "str | None" = None) -> str:
+    return _opaque_tag(tenant, key, "tenant")
+
+
+def redact_health(doc, key: "str | None" = None):
+    """A copy of a /healthz document with caller-supplied job ids
+    hashed. Job ids are arbitrary submitter strings (a tenant may well
+    encode what the job IS in its id) and /healthz deliberately stays
+    unauthenticated for orchestrator probes — so in token mode the
+    liveness body must not leak them. Liveness semantics (healthy,
+    stall flags, queue depth) are untouched; the operator correlates
+    the hashed id via the authenticated /varz."""
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k == "running_job" and isinstance(v, str):
+                    out[k] = _opaque_tag(v, key, "job")
+                elif k == "running_jobs" and isinstance(v, list):
+                    out[k] = [_opaque_tag(j, key, "job")
+                              if isinstance(j, str) else j for j in v]
+                else:
+                    out[k] = walk(v)
+            return out
+        if isinstance(node, list):
+            return [walk(x) for x in node]
+        return node
+
+    return walk(doc)
+
+
+def redact_varz(doc, viewer: "str | None" = None,
+                key: "str | None" = None):
+    """A copy of a /varz document with other tenants' values redacted.
+
+    Applied by the HTTP handler when `MPLC_TPU_METRICS_TOKEN` is set and
+    the caller authenticated with a per-tenant credential
+    (`tenant_token`): the bearer token gates ACCESS, this gates
+    CROSS-TENANT visibility — a consortium partner scraping its own
+    numbers must not read the other partners' job tables off the same
+    endpoint. Rules:
+
+      - any `jobs` table (dict of rows carrying a `tenant` field): rows
+        whose tenant != `viewer` collapse to
+        {tenant: <hashed tag>, status, priority, age_sec, redacted: True};
+      - dict keys carrying a `tenant=` metric label (the registry's
+        `name{tenant=...}` convention) are rewritten with the hashed tag
+        unless the label names the viewer;
+      - `tenant_device_seconds`-style per-tenant maps: non-viewer keys
+        are hashed (values kept — aggregate billing is not an identity).
+
+    `key` (the master token) makes the hashed tags HMAC-keyed — see
+    `_tenant_tag`."""
+    def _redact_key(k: str) -> str:
+        def sub(m):
+            t = m.group(1)
+            return ("tenant=" + t if viewer is not None and t == viewer
+                    else "tenant=" + _tenant_tag(t, key))
+        return _TENANT_LABEL_RE.sub(sub, k)
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, val in node.items():
+                if (k == "jobs" and isinstance(val, dict)
+                        and any(isinstance(r, dict) and "tenant" in r
+                                for r in val.values())):
+                    # the row KEY is the caller-supplied job id — itself
+                    # an identity/work leak (a tenant may encode what
+                    # the job is in its id), so redacted rows get an
+                    # opaque job tag too
+                    out[k] = {
+                        (jid if row.get("tenant") == viewer
+                         else _opaque_tag(jid, key, "job")):
+                        (dict(row) if row.get("tenant") == viewer
+                         else {"tenant": _tenant_tag(row.get("tenant"),
+                                                     key),
+                               **{f: row.get(f)
+                                  for f in _REDACTED_ROW_FIELDS},
+                               "redacted": True})
+                        for jid, row in val.items()}
+                elif (k == "tenant_device_seconds"
+                      and isinstance(val, dict)):
+                    out[k] = {(t if t == viewer
+                               else _tenant_tag(t, key)): v
+                              for t, v in val.items()}
+                elif isinstance(k, str) and "tenant=" in k:
+                    out[_redact_key(k)] = walk(val)
+                else:
+                    out[k] = walk(val)
+            return out
+        if isinstance(node, list):
+            return [walk(x) for x in node]
+        return node
+
+    return walk(doc)
+
+
 # -- Prometheus rendering -----------------------------------------------------
 
 _BRACKET_RE = re.compile(r"^(?P<base>[^\[\]]+)\[(?P<item>.+)\]$")
@@ -202,24 +364,82 @@ def prometheus_text() -> str:
 # -- the HTTP server ----------------------------------------------------------
 
 class _Handler(http.server.BaseHTTPRequestHandler):
+    def _auth_role(self, query: str) -> "tuple[str, str | None]":
+        """(role, viewer) for the request's bearer credential:
+
+          ("open", None)       — MPLC_TPU_METRICS_TOKEN unset (the
+                                 loopback default: everything open);
+          ("operator", None)   — the master token itself: full,
+                                 unredacted access;
+          ("tenant", <name>)   — the per-tenant HMAC credential
+                                 (`tenant_token(master, name)`) together
+                                 with `?tenant=<name>`: the viewer claim
+                                 is AUTHENTICATED, not self-declared —
+                                 tenant A cannot read tenant B's rows by
+                                 editing the query string;
+          ("denied", None)     — anything else.
+
+        Comparisons are constant-time over BYTES (a non-ASCII header
+        must 401, not TypeError the handler thread)."""
+        token = os.environ.get(METRICS_TOKEN_ENV)
+        if not token:
+            return "open", None
+        header = self.headers.get("Authorization", "")
+        supplied = header[7:] if header.startswith("Bearer ") else ""
+        supplied_b = supplied.encode("utf-8", "surrogateescape")
+        if hmac.compare_digest(supplied_b, token.encode()):
+            return "operator", None
+        viewer = urllib.parse.parse_qs(query).get("tenant", [None])[0]
+        if viewer is not None and hmac.compare_digest(
+                supplied_b, tenant_token(token, viewer).encode()):
+            return "tenant", viewer
+        return "denied", None
+
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
+            # operator-only when the token is set: the Prometheus text
+            # carries every tenant's labeled series and has no redacted
+            # rendering — a per-tenant credential does not unlock it
+            if self._auth_role(query)[0] not in ("open", "operator"):
+                return self._deny()
             body = prometheus_text().encode()
             self._reply(200, body, "text/plain; version=0.0.4")
         elif path == "/healthz":
+            # liveness stays unauthenticated: a 401ing health probe
+            # reads as "down" to every orchestrator that matters. In
+            # token mode, caller-supplied job ids are hashed out of the
+            # open body (liveness semantics untouched).
             healthy, view = health_view()
+            token = os.environ.get(METRICS_TOKEN_ENV)
+            if token:
+                view = redact_health(view, token)
             self._reply(200 if healthy else 503,
                         json.dumps(view, default=str).encode(),
                         "application/json")
         elif path == "/varz":
-            self._reply(200, json.dumps(varz_view(), default=str).encode(),
+            role, viewer = self._auth_role(query)
+            if role == "denied":
+                return self._deny()
+            doc = varz_view()
+            if role == "tenant":
+                # authenticated per-tenant view: everyone else's rows
+                # redacted under HMAC-keyed tags
+                doc = redact_varz(doc, viewer,
+                                  key=os.environ.get(METRICS_TOKEN_ENV))
+            self._reply(200, json.dumps(doc, default=str).encode(),
                         "application/json")
         elif path == "/":
             self._reply(200, b"mplc_tpu telemetry: /metrics /healthz /varz\n",
                         "text/plain")
         else:
             self._reply(404, b"not found\n", "text/plain")
+
+    def _deny(self) -> None:
+        self.send_response(401)
+        self.send_header("WWW-Authenticate", "Bearer")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
 
     def _reply(self, status: int, body: bytes, ctype: str) -> None:
         self.send_response(status)

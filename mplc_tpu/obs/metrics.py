@@ -58,6 +58,12 @@ Metric names used by the instrumented paths:
     engine.cpu_degraded_coalitions    counter  coalitions trained there
     engine.faults_injected            counter  faults fired by the
                                                MPLC_TPU_FAULT_PLAN hook
+    engine.device_step_sec            histogram measured device-step
+                                               seconds of FENCED batches
+                                               (MPLC_TPU_DEVICE_FENCE_RATE,
+                                               obs/devcost.py — a host
+                                               fetch timed with the
+                                               pipeline overlap drained)
     obs.memory_sample_errors          counter  sample_device_memory
                                                failures (warned once)
     obs.flight_dumps                  counter  flight-recorder postmortems
@@ -73,6 +79,16 @@ Per-tenant SLO series (service/scheduler.py, labeled `tenant=...`):
                                                deadline_sec
     service.job_retries               counter  failed attempts re-queued
     service.job_attempts              histogram attempts at job terminal
+    service.device_seconds            counter  metered device-seconds
+                                               billed per tenant
+                                               (obs/devcost.py: fenced-
+                                               sample extrapolation,
+                                               cost-model when fences
+                                               are off; journaled with
+                                               job terminals and
+                                               restored on replay, so
+                                               restarts don't lose
+                                               billing)
 
 Overload accounting (unlabeled; service/admission.py governor):
 

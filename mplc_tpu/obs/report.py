@@ -21,7 +21,18 @@ quantities every perf PR needs as a measured before/after:
     from the engine.batch events, and — when the caller supplies the
     model's forward FLOPs per sample (models/zoo.fwd_flops_per_sample or
     the XLA cost model) — a model-FLOPs rate over the evaluate wall-clock
-    plus an MFU proxy against a supplied peak-FLOPs figure;
+    plus an MFU proxy against a supplied peak-FLOPs figure (a HOST-side
+    proxy: dispatch is async, so the denominator is host wall-clock);
+    when the stream carries XLA cost truth the row additionally gains
+    `mfu_xla` — Compiled.cost_analysis() flops over measured device time
+    where fenced samples exist;
+  - a device_time row (MPLC_TPU_DEVICE_FENCE_RATE, obs/devcost.py):
+    measured device-step-seconds from the sampled fences, the
+    per-coalition extrapolated device-seconds figure, and the
+    enqueue/device/harvest host-overhead split;
+  - a roofline row: per-program achieved FLOP/s vs peak and bytes/s vs
+    HBM bandwidth with arithmetic intensity, from the program bank's
+    per-bundle cost analysis;
   - a resilience row: transient retries and backoff seconds
     (engine.retry events), OOM cap halvings and the CPU-path flip
     (engine.degrade), batches/coalitions that ran on the degraded CPU
@@ -70,7 +81,8 @@ def _pctl(values: list, q: float) -> float | None:
 
 def sweep_report(records: list, metrics_snapshot: dict | None = None,
                  flops_per_sample: float | None = None,
-                 peak_flops: float | None = None) -> dict:
+                 peak_flops: float | None = None,
+                 hbm_bytes_per_s: float | None = None) -> dict:
     """Aggregate a list of trace records (dicts) into the sweep report.
 
     `flops_per_sample` (the model's analytic/XLA-measured forward FLOPs for
@@ -78,7 +90,16 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     model-FLOPs rate (fwd+bwd ~ 3x fwd, padded rows and val/test evals
     excluded — a conservative lower bound on the device rate);
     `peak_flops` (the attached fleet's aggregate peak) additionally yields
-    `mfu_proxy` = achieved / peak."""
+    `mfu_proxy` = achieved / peak — a HOST-side proxy. When the record
+    stream carries XLA cost truth (per-batch `flops`/`bytes_accessed`
+    attrs from program-bank bundles) and/or sampled device fences
+    (`device_sec` attrs, MPLC_TPU_DEVICE_FENCE_RATE), the report
+    additionally derives `mfu_xla`, a `device_time` row (true
+    device-step seconds, host-overhead split, the fenced-extrapolation
+    device-seconds figure) and a per-program `roofline` row (achieved
+    FLOP/s vs `peak_flops`, bytes/s vs `hbm_bytes_per_s`, arithmetic
+    intensity). Record streams without those attrs — every pre-devcost
+    sidecar — produce exactly the old schema."""
     evaluate_s = prep_s = dispatch_s = harvest_s = compile_s = 0.0
     compile_overlapped_s = bank_wait_s = 0.0
     bank_compiles = bank_compiles_overlapped = 0
@@ -104,6 +125,16 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     recon_batches = recon_coalitions = 0
     recon_s = 0.0
     recorded = None
+    # device-time truth (obs/devcost.py): fenced device-step samples and
+    # XLA-modeled per-batch cost, when the stream carries them
+    fence_samples: list = []        # measured device_sec per fenced batch
+    fenced_coalitions = 0
+    fence_interval = None
+    flops_total = bytes_total = 0.0
+    costed_batches = 0
+    costed_span_s = 0.0
+    fenced_flops = fenced_flops_sec = 0.0
+    roof: dict = {}                 # (slot_count, width) -> cost buckets
 
     for rec in records:
         name = rec.get("name")
@@ -186,6 +217,36 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
                 recon_batches += 1
                 recon_coalitions += int(a.get("coalitions", 0))
                 recon_s += dur
+            dsec = a.get("device_sec")
+            fl = a.get("flops")
+            if dsec is not None:
+                fence_samples.append(float(dsec))
+                fenced_coalitions += int(a.get("coalitions", 0))
+            if fl:
+                flops_total += float(fl)
+                bytes_total += float(a.get("bytes_accessed") or 0.0)
+                costed_batches += 1
+                costed_span_s += dur
+                rb = roof.setdefault(k, {
+                    "batches": 0, "flops": 0.0, "bytes": 0.0,
+                    "span_s": 0.0, "fenced_s": 0.0, "fenced_flops": 0.0,
+                    "fenced_bytes": 0.0})
+                rb["batches"] += 1
+                rb["flops"] += float(fl)
+                rb["bytes"] += float(a.get("bytes_accessed") or 0.0)
+                rb["span_s"] += dur
+                if dsec is not None:
+                    rb["fenced_s"] += float(dsec)
+                    rb["fenced_flops"] += float(fl)
+                    rb["fenced_bytes"] += float(a.get("bytes_accessed")
+                                                or 0.0)
+                    fenced_flops += float(fl)
+                    fenced_flops_sec += float(dsec)
+        elif name == "engine.device_fence":
+            # the fence's own event carries the sampling config; the
+            # per-batch samples are aggregated off engine.batch above
+            if a.get("interval"):
+                fence_interval = int(a["interval"])
         elif name == "recon.record":
             # the grand-coalition recording run (one per engine); the last
             # event wins, like the trust row
@@ -210,8 +271,22 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             # one scheduling quantum of the sweep service: per-tenant
             # batch/sample accounting for fair-share cost attribution
             t = svc_tenants.setdefault(a.get("tenant", "?"), {
-                "slices": 0, "batches": 0, "coalitions": 0, "epochs": 0,
-                "samples": 0, "packed_batches": 0, "seconds": 0.0})
+                "slices": 0, "failed_slices": 0, "batches": 0,
+                "coalitions": 0, "epochs": 0, "samples": 0,
+                "packed_batches": 0, "seconds": 0.0,
+                "device_seconds": 0.0})
+            # metered device-seconds billed to this quantum
+            # (scheduler._meter_quantum; absent on pre-devcost streams)
+            t["device_seconds"] += float(a.get("device_sec") or 0.0)
+            if a.get("outcome"):
+                # the replacement event for a cancelled/faulted quantum
+                # (its real span was cancelled, never emitted): its
+                # device billing counts above, but slice counts,
+                # span-seconds and the slo quantiles must keep mirroring
+                # the live service.slice_sec histogram — which observes
+                # only SUCCESSFUL quanta
+                t["failed_slices"] += 1
+                continue
             t["slices"] += 1
             t["batches"] += int(a.get("batches", 0))
             t["coalitions"] += int(a.get("coalitions", 0))
@@ -274,6 +349,25 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             if peak_flops:
                 compute["mfu_proxy"] = \
                     compute["model_flops_per_s"] / peak_flops
+    # XLA-derived utilization (obs/devcost.py): modeled flops come from
+    # Compiled.cost_analysis() instead of the hand-derived analytic
+    # estimate, and — when fenced samples exist — the denominator is
+    # measured DEVICE time instead of host span. Supersedes mfu_proxy
+    # when present; the analytic proxy stays rendered as the fallback.
+    if flops_total:
+        compute["model_flops_xla"] = flops_total
+        if fenced_flops_sec:
+            compute["xla_flops_per_s"] = fenced_flops / fenced_flops_sec
+            compute["mfu_xla_basis"] = "device_fenced"
+        elif costed_span_s:
+            compute["xla_flops_per_s"] = flops_total / costed_span_s
+            compute["mfu_xla_basis"] = "host_span"
+        else:
+            compute["xla_flops_per_s"] = None
+            compute["mfu_xla_basis"] = None
+        compute["mfu_xla"] = (compute["xla_flops_per_s"] / peak_flops
+                              if compute["xla_flops_per_s"] and peak_flops
+                              else None)
 
     report = {
         "wallclock": {
@@ -373,12 +467,102 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             "train_partner_passes": partner_passes,
             "train_batches": batches - recon_batches,
         }
+    if fence_samples or flops_total:
+        # device-time truth: fenced device-step samples (the measured
+        # side) and the host-overhead split. The extrapolation rule is
+        # per-COALITION (batch widths vary): device_s ≈ fenced seconds ×
+        # TRAINING coalitions / fenced coalitions — eval-only
+        # reconstruction coalitions cost orders of magnitude less and
+        # are excluded from the training-rate extrapolation (their count
+        # is reported separately). With fences off but XLA cost known, a
+        # peak figure yields the cost-model estimate instead (an
+        # optimistic lower bound — assumes peak-rate execution).
+        fs = sorted(fence_samples)
+        # eval-only reconstruction AND CPU-degraded-rung coalitions are
+        # excluded: both run at rates wildly different from a fenced
+        # device training batch (the CPU rung no longer fences at all)
+        train_coalitions = coalitions - recon_coalitions - cpu_coalitions
+        if fenced_coalitions and train_coalitions > 0:
+            device_s = (sum(fence_samples) * train_coalitions
+                        / fenced_coalitions)
+            basis = "fenced"
+        elif flops_total and peak_flops:
+            device_s = flops_total / peak_flops
+            basis = "cost_model"
+        else:
+            device_s, basis = None, None
+        report["device_time"] = {
+            "fence_interval": fence_interval,
+            "fenced_batches": len(fence_samples),
+            "fenced_coalitions": fenced_coalitions,
+            "device_step_s": {
+                "count": len(fs),
+                "sum": sum(fs),
+                "mean": sum(fs) / len(fs) if fs else None,
+                "p50": _pctl(fs, 0.50),
+                "p95": _pctl(fs, 0.95),
+                "max": fs[-1] if fs else None,
+            },
+            "device_s": device_s,
+            "basis": basis,
+            # eval-only reconstruction / CPU-degraded coalitions
+            # excluded from the training-rate extrapolation above
+            # (billed at host span by the meter)
+            "eval_coalitions_excluded": recon_coalitions,
+            "degraded_coalitions_excluded": cpu_coalitions,
+            # the host-overhead split the fences make meaningful:
+            # enqueue (dispatch spans) vs device (above) vs harvest
+            "enqueue_s": dispatch_s,
+            "harvest_s": harvest_s,
+            "prep_s": prep_s,
+        }
+    if roof:
+        # per-program roofline: XLA-modeled flops/bytes per bundle
+        # execution against the fleet's peak FLOP/s and HBM bandwidth.
+        # Achieved rates use measured fenced device time when the
+        # program has samples, the (pipelining-inflated) host span
+        # otherwise — the basis says which.
+        rows = []
+        for (slot_count, width), rb in sorted(
+                roof.items(), key=lambda kv: (kv[0][0] is None,
+                                              kv[0][0] or 0, kv[0][1])):
+            if rb["fenced_s"]:
+                ach_f = rb["fenced_flops"] / rb["fenced_s"]
+                ach_b = rb["fenced_bytes"] / rb["fenced_s"]
+                basis = "device_fenced"
+            elif rb["span_s"]:
+                ach_f = rb["flops"] / rb["span_s"]
+                ach_b = rb["bytes"] / rb["span_s"]
+                basis = "host_span"
+            else:
+                ach_f = ach_b = basis = None
+            rows.append({
+                "slot_count": slot_count, "width": width,
+                "batches": rb["batches"],
+                "flops_per_batch": rb["flops"] / rb["batches"],
+                "bytes_per_batch": rb["bytes"] / rb["batches"],
+                "arithmetic_intensity": (rb["flops"] / rb["bytes"]
+                                         if rb["bytes"] else None),
+                "achieved_flops_per_s": ach_f,
+                "achieved_bytes_per_s": ach_b,
+                "basis": basis,
+                "mfu": (ach_f / peak_flops
+                        if ach_f and peak_flops else None),
+                "hbm_fraction": (ach_b / hbm_bytes_per_s
+                                 if ach_b and hbm_bytes_per_s else None),
+            })
+        report["roofline"] = {"peak_flops": peak_flops,
+                              "hbm_peak_bytes_per_s": hbm_bytes_per_s,
+                              "programs": rows}
     if svc_tenants or svc_jobs:
         # the multi-tenant service view: job outcomes, the cross-tenant
         # program-packing win, and fair-share cost attribution — each
-        # tenant's share of the service's measured batch span-seconds
-        # (the per-batch accounting the ROADMAP item asked to reuse)
+        # tenant's share of the service's metered DEVICE-seconds
+        # (obs/devcost.py; span-seconds kept as host_share, and the
+        # cost_share falls back to it for pre-devcost record streams)
         total_s = sum(t["seconds"] for t in svc_tenants.values())
+        total_dev = sum(t.get("device_seconds", 0.0)
+                        for t in svc_tenants.values())
         by_status: dict = {}
         for a in svc_jobs.values():
             s = a.get("status", "?")
@@ -395,9 +579,22 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
                              if a.get("recovered")),
             "cross_tenant_packed_batches": sum(
                 t["packed_batches"] for t in svc_tenants.values()),
+            # cost_share bills by metered DEVICE-seconds when the stream
+            # carries them (what the accelerator actually did for each
+            # tenant), falling back to the old span-seconds share for
+            # pre-devcost streams; host_share is always the span view
+            "cost_basis": ("device_seconds"
+                           if any(t.get("device_seconds")
+                                  for t in svc_tenants.values())
+                           else "host_span"),
             "per_tenant": {
-                name: {**t, "cost_share": (t["seconds"] / total_s
-                                           if total_s else None)}
+                name: {**t,
+                       "host_share": (t["seconds"] / total_s
+                                      if total_s else None),
+                       "cost_share": (
+                           t.get("device_seconds", 0.0) / total_dev
+                           if total_dev else
+                           (t["seconds"] / total_s if total_s else None))}
                 for name, t in sorted(svc_tenants.items())},
         }
         # the per-tenant SLO view: exact quantiles over the collected
@@ -527,12 +724,19 @@ def format_report(report: dict) -> str:
         lines.append(line)
         for name, t in (svc.get("per_tenant") or {}).items():
             share = t.get("cost_share")
-            lines.append(
+            host = t.get("host_share")
+            line = (
                 f"    tenant[{name}]  slices={t['slices']}  "
                 f"batches={t['batches']}  coalitions={t['coalitions']}  "
-                f"samples={t['samples']}  span={t['seconds']:.2f}s  "
-                "share="
-                + (f"{share:.1%}" if share is not None else "n/a"))
+                f"samples={t['samples']}  span={t['seconds']:.2f}s")
+            if t.get("device_seconds"):
+                line += f"  device={t['device_seconds']:.2f}s"
+            line += ("  share="
+                     + (f"{share:.1%}" if share is not None else "n/a"))
+            if (host is not None and share is not None
+                    and svc.get("cost_basis") == "device_seconds"):
+                line += f" (host={host:.1%})"
+            lines.append(line)
     slo = report.get("slo")
     if slo:
         def _q(d, k):
@@ -596,7 +800,62 @@ def format_report(report: dict) -> str:
             mfu = c.get("mfu_proxy")
             line += ("  mfu_proxy=" + (f"{mfu:.2%}" if mfu is not None
                                        else "n/a"))
+        mx = c.get("mfu_xla")
+        if mx is not None:
+            # the XLA-derived figure supersedes the analytic proxy (both
+            # stay rendered; the basis says whether the denominator was
+            # measured device time or host span)
+            line += (f"  mfu_xla={mx:.2%}"
+                     + (f" [{c['mfu_xla_basis']}]"
+                        if c.get("mfu_xla_basis") else ""))
         lines.append(line)
+    dt = report.get("device_time")
+    if dt is not None:
+        st = dt.get("device_step_s") or {}
+        line = (f"  device      fenced={dt.get('fenced_batches', 0)} "
+                f"batches ({dt.get('fenced_coalitions', 0)} coalitions"
+                + (f", 1/{dt['fence_interval']}"
+                   if dt.get("fence_interval") else "") + ")")
+        if st.get("count"):
+            mean = st.get("mean")
+            p95 = st.get("p95")
+            line += ("  step mean="
+                     + (f"{mean:.3f}s" if mean is not None else "n/a")
+                     + "  p95="
+                     + (f"{p95:.3f}s" if p95 is not None else "n/a"))
+        ds = dt.get("device_s")
+        if ds is not None:
+            line += (f"  device_s~{ds:.2f}"
+                     + (f" [{dt['basis']}]" if dt.get("basis") else ""))
+        line += (f"  enqueue={dt.get('enqueue_s', 0.0):.2f}s  "
+                 f"harvest={dt.get('harvest_s', 0.0):.2f}s")
+        lines.append(line)
+    rl = report.get("roofline")
+    if rl and rl.get("programs"):
+        def _rate(v, unit):
+            if v is None:
+                return "n/a"
+            return (f"{v / 1e12:.2f}T{unit}" if v >= 1e12 else
+                    f"{v / 1e9:.2f}G{unit}" if v >= 1e9 else
+                    f"{v / 1e6:.2f}M{unit}")
+        for r in rl["programs"]:
+            ai = r.get("arithmetic_intensity")
+            line = (f"  roofline    ({str(r['slot_count']):>4}, "
+                    f"{r['width']:4d})  "
+                    f"flops/batch={_rate(r.get('flops_per_batch'), 'F')}  "
+                    "AI="
+                    + (f"{ai:.1f}F/B" if ai is not None else "n/a")
+                    + "  achieved="
+                    + _rate(r.get("achieved_flops_per_s"), "F/s"))
+            if r.get("mfu") is not None:
+                line += f" ({r['mfu']:.1%} peak)"
+            if r.get("hbm_fraction") is not None:
+                line += (f"  bytes="
+                         + _rate(r.get("achieved_bytes_per_s"), "B/s")
+                         + f" ({r['hbm_fraction']:.1%} HBM)")
+            if r.get("basis"):
+                line += f" [{r['basis']}]"
+            lines.append(line)
     if report["per_width"]:
         lines.append("  throughput per bucket (slots, width): "
                      "batches  coal  epochs  span-s  coal/s")

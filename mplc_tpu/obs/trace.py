@@ -83,6 +83,10 @@ SPAN_REGISTRY = {
                     "attrs: ordinal/width/slot_count/coalitions/padding/"
                     "epochs/samples/partner_passes)",
     "engine.hbm": "per-evaluate HBM/donation footprint snapshot",
+    "engine.device_fence": "sampled device fence: a batch dispatched "
+                           "without overlap and timed through a host "
+                           "fetch — true device-step seconds (attrs: "
+                           "ordinal/width/coalitions/interval)",
     "engine.retry": "transient-failure retry (attrs: site/attempt/"
                     "backoff_sec/ordinal)",
     "engine.degrade": "OOM ladder rung (attrs: action=halve_cap|"

@@ -33,6 +33,12 @@ Replay distinguishes two failure shapes:
 Float values round-trip exactly through `json` (repr-based float
 serialization), so replayed v(S) tables are bit-identical to the
 harvested ones — the property the service's recovery invariant rests on.
+
+Terminal records (`done` / `cancel` / `quarantine` / `shed`) carry the
+job's metered `device_seconds` (+ `tenant`, `device_basis` —
+obs/devcost.py): replay restores the per-tenant billing meter, so a
+kill→restart continues `service.device_seconds{tenant=...}` where the
+killed process stopped instead of zeroing every tenant's bill.
 """
 
 from __future__ import annotations

@@ -73,6 +73,21 @@ The headline is the fault model, not the queue:
   to an uninterrupted run (same per-coalition rng-fold streams; the
   engine's batch composition never affects v(S)).
 
+  **Device-seconds metering.** Every quantum bills its engine's
+  device-meter delta (obs/devcost.py) to the owning tenant: fenced-
+  sample-extrapolated measured seconds when the engine fences
+  (MPLC_TPU_DEVICE_FENCE_RATE), XLA-cost-model seconds when fences are
+  off, host span as the explicit last resort — the basis rides the
+  `service.slice` span and the terminal `service.job` event. The meter
+  is exported per tenant (`service.device_seconds{tenant=...}` on
+  /metrics, `tenant_device_seconds` on /varz), drives the report's
+  `cost_share` (span-seconds kept as `host_share`), and is JOURNALED
+  with every job terminal so a kill→restart never loses billing.
+  `submit(..., profile=True)` additionally captures a `jax.profiler`
+  device trace of exactly that job's quanta into
+  `MPLC_TPU_PROFILE_DIR/<job_id>` (best-effort; path on the terminal
+  event).
+
 Live telemetry: when `MPLC_TPU_METRICS_PORT` is set, constructing a
 service starts the obs/export.py HTTP plane — /metrics (Prometheus,
 incl. the per-tenant SLO histograms instrumented here: queue wait,
@@ -101,8 +116,10 @@ accounting machinery holds at thousands of jobs.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -110,6 +127,7 @@ from collections import deque
 import numpy as np
 
 from .. import constants, faults
+from ..obs import devcost
 from ..obs import export as obs_export
 from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
@@ -189,7 +207,7 @@ class SweepJob:
     `result()` blocks for the final contributivity scores."""
 
     def __init__(self, service, job_id, tenant, scenario, method,
-                 deadline_sec, ordinal, priority=0):
+                 deadline_sec, ordinal, priority=0, profile=False):
         self.service = service
         self.job_id = job_id
         self.tenant = tenant
@@ -198,6 +216,18 @@ class SweepJob:
         self.deadline_sec = deadline_sec
         self.ordinal = ordinal  # 1-based submission ordinal (fault plan)
         self.priority = int(priority)  # tier: higher = more important
+        # per-job device profiling (utils.profile_trace): when True and
+        # MPLC_TPU_PROFILE_DIR is set, every quantum of THIS job runs
+        # under a jax.profiler device trace into <dir>/<job_id>; the
+        # trace path lands on the terminal service.job event
+        self.profile = bool(profile)
+        self.profile_path: "str | None" = None
+        # metered device-seconds billed to this job (obs/devcost.py):
+        # fenced-sample extrapolation when the engine fences, cost-model
+        # (XLA flops / fleet peak) when fences are off, host span as the
+        # explicit last resort — `device_basis` names the best basis seen
+        self.device_seconds = 0.0
+        self.device_basis: "str | None" = None
         # the job's resolved service-fault entry (explicit plan merged
         # with the chaos draw), snapshotted at submit so consumption
         # (stall fires once) is per-job state, never shared plan state
@@ -335,6 +365,61 @@ class _WorkerSlot:
         }
 
 
+# jax.profiler admits ONE trace at a time per process: quanta of
+# profiled jobs serialize their captures through this lock; a quantum
+# that can't get it (another profiled job's quantum is mid-capture on a
+# sibling worker) simply runs unprofiled — profiling is best-effort
+# observability, never a scheduling constraint
+_PROFILE_LOCK = threading.Lock()
+_profile_warned = False
+
+
+class _QuantumProfiler:
+    """Best-effort `jax.profiler` device trace of ONE scheduling quantum
+    (utils.profile_trace's start/stop pair, serialized process-wide).
+    NEVER raises into the quantum: a profiler failure is a warning and
+    the quantum runs unprofiled — a job must not quarantine because
+    observability hiccuped."""
+
+    def __init__(self, job, path: str):
+        self.job = job
+        self.path = path
+        self._active = False
+
+    def __enter__(self) -> "_QuantumProfiler":
+        global _profile_warned
+        if not _PROFILE_LOCK.acquire(blocking=False):
+            return self  # a sibling quantum owns the profiler
+        try:
+            import jax
+            jax.profiler.start_trace(self.path)
+            self._active = True
+            self.job.profile_path = self.path
+        except Exception as e:
+            _PROFILE_LOCK.release()
+            if not _profile_warned:
+                _profile_warned = True
+                logger.warning(
+                    "service: jax.profiler trace for job %s failed to "
+                    "start (%s); the job runs unprofiled",
+                    self.job.job_id, e)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._active:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as e:
+                logger.warning(
+                    "service: jax.profiler stop_trace failed for job %s "
+                    "(%s)", self.job.job_id, e)
+            finally:
+                self._active = False
+                _PROFILE_LOCK.release()
+        return False
+
+
 class SweepService:
     """The long-lived multi-tenant sweep scheduler (module docstring)."""
 
@@ -386,6 +471,11 @@ class SweepService:
         obs_export.register_varz(self._provider_key,
                                  weakref.WeakMethod(self.varz_view))
 
+        # lifetime device-seconds metered per tenant (obs/devcost.py) —
+        # fed by every quantum's meter delta AND by journal replay below
+        # (terminal records carry the meter), so a restarted service's
+        # billing continues where the killed one stopped
+        self._tenant_device_seconds: dict = {}
         # journal replay BEFORE the append handle opens: a restart reads
         # history (quarantining a torn tail), then appends to it
         self._journal = None
@@ -404,6 +494,19 @@ class SweepService:
             records, _torn = SweepJournal.replay(journal_path)
             for rec in records:
                 self._replay_record(rec)
+            # restore the /metrics billing counter by RAISING it to the
+            # journal's per-tenant totals, never blind-incrementing: the
+            # counter is process-global, so a service reconstructed in
+            # the SAME process as the one that billed live (tests, an
+            # embedding app restarting its service object) must not
+            # double-count what both the live path and the journal saw.
+            # A fresh process starts at zero and lands exactly on the
+            # journaled totals.
+            for tenant, total in self._tenant_device_seconds.items():
+                c = obs_metrics.counter("service.device_seconds",
+                                        tenant=tenant)
+                if total > c.value:
+                    c.inc(total - c.value)
             self._journal = SweepJournal(journal_path)
 
         if start:
@@ -461,6 +564,18 @@ class SweepService:
             self._recovered[job]["cancelled"] = True
         elif kind == "shed" and job in self._recovered:
             self._recovered[job]["shed"] = True
+        if kind in ("done", "quarantine", "cancel", "shed"):
+            # terminal records carry the job's metered device-seconds:
+            # restore the per-tenant meter so a kill→restart never
+            # loses billing (the /metrics counter is raised AFTER the
+            # whole replay — see __init__)
+            ds = rec.get("device_seconds")
+            if ds:
+                tenant = (rec.get("tenant")
+                          or (self._recovered.get(job) or {}).get("tenant")
+                          or "?")
+                self._tenant_device_seconds[tenant] = \
+                    self._tenant_device_seconds.get(tenant, 0.0) + float(ds)
 
     # -- live telemetry providers ---------------------------------------
 
@@ -553,6 +668,8 @@ class SweepService:
                     "recovered_values": j.recovered_values,
                     "deadline_sec": j.deadline_sec,
                     "age_sec": time.monotonic() - j.submitted_at,
+                    "device_seconds": round(j.device_seconds, 6),
+                    "device_basis": j.device_basis,
                 } for job_id, j in self._jobs.items()
                 if not j.done or job_id in recent_terminal}
             listed_terminal = sum(1 for row in jobs.values()
@@ -575,6 +692,12 @@ class SweepService:
                 "admission": self._admission.view(),
                 "closed": self._closed,
                 "recovered_jobs": len(self._recovered),
+                # lifetime metered device-seconds per tenant (restored
+                # from the journal on restart — the billing meter)
+                "tenant_device_seconds": {
+                    t: round(v, 6)
+                    for t, v in sorted(
+                        self._tenant_device_seconds.items())},
             }
 
     def recovered_jobs(self) -> list:
@@ -596,13 +719,20 @@ class SweepService:
                tenant: str = "tenant0",
                deadline_sec: "float | None" = None,
                job_id: "str | None" = None,
-               priority: "int | None" = None) -> SweepJob:
+               priority: "int | None" = None,
+               profile: bool = False) -> SweepJob:
         """Accept a Scenario+method job onto the bounded queue.
 
         `priority` is the job's integer tier (default
         `MPLC_TPU_SERVICE_PRIORITY_DEFAULT`, 0; higher = more
         important): the scheduler weights quanta by `tier + 1` and the
         overload governor defers/sheds the lowest tier first.
+
+        `profile=True` captures a `jax.profiler` device trace of exactly
+        this job's quanta into `MPLC_TPU_PROFILE_DIR/<job_id>` (a no-op
+        when the dir knob is unset; best-effort — a profiler failure
+        degrades to a warning, never a job fault). The trace path is
+        recorded on the job's terminal `service.job` event.
 
         Raises `ServiceClosed` after shutdown, `ServiceOverloaded` when
         the queue is at `MPLC_TPU_SERVICE_MAX_PENDING` (backpressure —
@@ -659,7 +789,8 @@ class SweepService:
                 raise ValueError(f"job id {job_id!r} already submitted "
                                  "to this service")
             job = SweepJob(self, job_id, tenant, scenario, method,
-                           deadline_sec, ordinal, priority=priority)
+                           deadline_sec, ordinal, priority=priority,
+                           profile=profile)
             job._fault_entry = entry
             if self._journal is not None:
                 # journal BEFORE registering: an un-journalable
@@ -931,16 +1062,31 @@ class SweepService:
         span = obs_trace.start_span("service.slice", tenant=job.tenant,
                                     job=job.job_id)
         try:
-            with self._device_ctx(worker):
+            with self._device_ctx(worker), self._profile_ctx(job):
                 return self._run_quantum_body(job, span)
         finally:
             self._tl.worker = None
 
+    def _profile_ctx(self, job: SweepJob):
+        """The per-job device-trace context (submit's `profile=True`
+        flag x `MPLC_TPU_PROFILE_DIR`): captures exactly this job's
+        quanta — sibling tenants' quanta on other workers never enter
+        the trace."""
+        if not job.profile:
+            return contextlib.nullcontext()
+        profile_dir = os.environ.get("MPLC_TPU_PROFILE_DIR")
+        if not profile_dir:
+            return contextlib.nullcontext()
+        return _QuantumProfiler(job, os.path.join(profile_dir, job.job_id))
+
     def _run_quantum_body(self, job: SweepJob, span) -> bool:
+        meter_before = None
         try:
             if job.engine is None:
                 self._build_engine(job)
             eng = job.engine
+            meter = getattr(eng, "device_meter", None)
+            meter_before = meter.snapshot() if meter is not None else None
             b0, e0 = eng._batch_ordinal, eng.epochs_trained
             s0, p0 = eng.samples_trained, job.packed_batches
             c0 = len(eng.charac_fct_values)
@@ -948,12 +1094,15 @@ class SweepService:
                 finished = self._run_exact_slice(job)
             else:
                 finished = self._run_method_quantum(job)
+            dev_sec, dev_basis = self._meter_quantum(job, meter_before)
+            meter_before = None  # billed; the except paths must not re-bill
             span.attrs.update(
                 batches=eng._batch_ordinal - b0,
                 coalitions=len(eng.charac_fct_values) - c0,
                 epochs=eng.epochs_trained - e0,
                 samples=eng.samples_trained - s0,
-                packed_batches=job.packed_batches - p0)
+                packed_batches=job.packed_batches - p0,
+                device_sec=dev_sec, device_basis=dev_basis)
             span.end()
             obs_metrics.histogram(
                 "service.slice_sec", tenant=job.tenant).observe(
@@ -964,6 +1113,7 @@ class SweepService:
             return True
         except JobCancelled as e:
             span.cancel()
+            self._bill_failed_quantum(job, meter_before, span, "cancelled")
             self._journal_new_values(job)  # keep what the drain harvested
             self._terminal(job, "cancelled", e)
             return False
@@ -971,9 +1121,11 @@ class SweepService:
             raise
         except BaseException as e:  # noqa: BLE001 — the isolation boundary
             span.cancel()
-            # preserve whatever the failed attempt harvested before the
-            # fault: the journal (and the engine memo) make the retry a
-            # bit-identical continuation, not a restart
+            # bill + preserve whatever the failed attempt harvested
+            # before the fault: the journal (and the engine memo) make
+            # the retry a bit-identical continuation, not a restart —
+            # and the tenant pays for the device time its fault consumed
+            self._bill_failed_quantum(job, meter_before, span, "fault")
             try:
                 self._journal_new_values(job)
             except Exception:
@@ -981,6 +1133,48 @@ class SweepService:
                     "service: journaling after a fault failed for %s",
                     job.job_id)
             return self._fail_attempt(job, e)
+
+    def _bill_failed_quantum(self, job: SweepJob, before: "dict | None",
+                             span, outcome: str) -> None:
+        """Billing for a quantum that did NOT complete (deadline cancel,
+        fault): the tenant pays for the device time its quantum
+        consumed, and — because the `service.slice` span was CANCELLED,
+        never emitted — a replacement slice EVENT carries the billed
+        delta into the trace stream. Without it the report's per-tenant
+        device_seconds/cost_share would silently disagree with the
+        /metrics counter and the journal for exactly the tenants whose
+        faults consumed device time."""
+        dsec, dbasis = self._meter_quantum(job, before)
+        if dsec:
+            obs_trace.event(
+                "service.slice", dur=span.duration or 0.0,
+                tenant=job.tenant, job=job.job_id,
+                device_sec=dsec, device_basis=dbasis, outcome=outcome)
+
+    def _meter_quantum(self, job: SweepJob,
+                       before: "dict | None") -> "tuple[float, str | None]":
+        """Bill the quantum's device-seconds delta (obs/devcost.py) to
+        the job and its tenant: the `service.device_seconds{tenant=...}`
+        counter, the scheduler's lifetime per-tenant map (/varz), and
+        the job's own total (journaled at terminal). Returns the
+        (seconds, basis) pair the `service.slice` span records."""
+        eng = job.engine
+        meter = getattr(eng, "device_meter", None) if eng is not None \
+            else None
+        if meter is None or before is None:
+            return 0.0, None
+        delta = devcost.meter_delta(before, meter.snapshot())
+        sec, basis = devcost.estimate_device_seconds(
+            delta, devcost.fleet_peak_flops())
+        if sec > 0:
+            job.device_seconds += sec
+            job.device_basis = devcost.merge_basis(job.device_basis, basis)
+            obs_metrics.counter("service.device_seconds",
+                                tenant=job.tenant).inc(sec)
+            with self._lock:
+                self._tenant_device_seconds[job.tenant] = \
+                    self._tenant_device_seconds.get(job.tenant, 0.0) + sec
+        return sec, (basis if sec > 0 else None)
 
     def _fail_attempt(self, job: SweepJob, err: BaseException) -> bool:
         """Attempt-level retry/quarantine policy. Retryable failures
@@ -1235,7 +1429,12 @@ class SweepService:
                 job.engine.partners_count, job.engine.charac_fct_values)
         job.values = dict(job.engine.charac_fct_values)
         job.status = "completed"
-        self._journal_safe({"type": "done", "job": job.job_id})
+        # the terminal record carries the job's metered device-seconds:
+        # replay restores per-tenant billing across restarts
+        self._journal_safe({"type": "done", "job": job.job_id,
+                            "tenant": job.tenant,
+                            "device_seconds": job.device_seconds,
+                            "device_basis": job.device_basis})
         obs_metrics.counter("service.jobs_completed").inc()
         obs_metrics.histogram("service.job_attempts",
                               tenant=job.tenant).observe(job.attempts)
@@ -1245,6 +1444,10 @@ class SweepService:
             recovered=job.recovered_values > 0,
             packed_batches=job.packed_batches,
             seconds=time.monotonic() - job.submitted_at,
+            device_seconds=job.device_seconds,
+            device_basis=job.device_basis,
+            **({"profile_path": job.profile_path}
+               if job.profile_path else {}),
             **job._slo_attrs())
         self._release_engine_data(job)
         self._retire(job)
@@ -1262,6 +1465,9 @@ class SweepService:
         kind = {"cancelled": "cancel", "quarantined": "quarantine",
                 "shed": "shed"}[status]
         self._journal_safe({"type": kind, "job": job.job_id,
+                            "tenant": job.tenant,
+                            "device_seconds": job.device_seconds,
+                            "device_basis": job.device_basis,
                             "error": str(err)[:500]})
         counter = {"cancelled": "service.jobs_cancelled",
                    "quarantined": "service.jobs_quarantined",
@@ -1275,6 +1481,10 @@ class SweepService:
             recovered=job.recovered_values > 0,
             packed_batches=job.packed_batches,
             seconds=time.monotonic() - job.submitted_at,
+            device_seconds=job.device_seconds,
+            device_basis=job.device_basis,
+            **({"profile_path": job.profile_path}
+               if job.profile_path else {}),
             error=str(err)[:200], **job._slo_attrs())
         self._retire(job)
         job._finish()

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Perf-trajectory gate: diff two bench telemetry sidecars row by row.
+
+The repo records a telemetry sidecar per bench run
+(`perf/telemetry_config<N>.json`, bench.py `_write_telemetry`) but until
+this script nothing COMPARED them — the BENCH trajectory existed only as
+disconnected JSON blobs, and a perf regression surfaced only if a human
+eyeballed two files. This tool turns any two sidecars (or two run
+directories of them) into a per-row delta table with a configurable
+regression threshold, and exits non-zero when a tracked metric regressed
+past it — a perf gate a driver (or CI) can wire in front of a merge.
+
+Usage:
+    python scripts/bench_diff.py OLD.json NEW.json [--threshold 0.10]
+    python scripts/bench_diff.py perf_run_A/ perf_run_B/ [--threshold ...]
+
+Directory mode pairs up `telemetry_config*.json` files by name and
+diffs each pair (files present on only one side are reported, not
+fatal). Exit codes: 0 = no regression, 1 = at least one row regressed
+past the threshold, 2 = usage/JSON error.
+
+Every compared row is DIRECTION-aware ("lower" = smaller is better,
+"higher" = bigger is better); rows missing from either side are skipped
+(schema growth — e.g. the device/roofline rows appearing — is never a
+regression). Provenance guards: a fresh number diffed against a
+`cpu_fallback` or `replayed_cache` sidecar is flagged as incomparable
+(the scales differ), and a `degraded: true` side is annotated — a
+number earned through the OOM ladder is not a like-for-like baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# row path in the sidecar -> direction. Paths walk nested dicts; the
+# `per_width[slots,width]` rows are expanded dynamically below.
+_ROWS = {
+    "wallclock_s": "lower",
+    "report.wallclock.evaluate_s": "lower",
+    "report.wallclock.compile_s": "lower",
+    "report.wallclock.prep_s": "lower",
+    "report.wallclock.dispatch_s": "lower",
+    "report.wallclock.harvest_s": "lower",
+    "report.memo.hit_rate": "higher",
+    "report.batches.pad_waste_fraction": "lower",
+    "report.compute.samples_per_s": "higher",
+    "report.compute.model_flops_per_s": "higher",
+    "report.compute.mfu_proxy": "higher",
+    "report.compute.mfu_xla": "higher",
+    "report.device_time.device_s": "lower",
+    "report.resilience.retries": "lower",
+    "report.resilience.cap_halvings": "lower",
+}
+
+
+def _get_path(doc: dict, path: str):
+    node = doc
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node if isinstance(node, (int, float)) else None
+
+
+def extract_rows(doc: dict) -> dict:
+    """`{row_name: (value, direction)}` for every tracked numeric row a
+    sidecar carries. Tolerates bare reports (no `report` wrapper) and
+    pre-devcost sidecars (absent rows are just absent)."""
+    if "report" not in doc and "wallclock" in doc:
+        doc = {"report": doc}
+    rows = {}
+    for path, direction in _ROWS.items():
+        v = _get_path(doc, path)
+        if v is not None:
+            rows[path] = (float(v), direction)
+    # per-bucket throughput: one row per (slots, width) program
+    for r in (doc.get("report", {}).get("per_width") or []):
+        v = r.get("coalitions_per_s")
+        if v is not None:
+            name = (f"report.per_width[{r.get('slot_count')},"
+                    f"{r.get('width')}].coalitions_per_s")
+            rows[name] = (float(v), "higher")
+    # per-program roofline: achieved FLOP/s per (slots, width)
+    for r in ((doc.get("report", {}).get("roofline") or {})
+              .get("programs") or []):
+        v = r.get("achieved_flops_per_s")
+        if v is not None:
+            name = (f"report.roofline[{r.get('slot_count')},"
+                    f"{r.get('width')}].achieved_flops_per_s")
+            rows[name] = (float(v), "higher")
+    return rows
+
+
+def _provenance(doc: dict) -> str:
+    return str(doc.get("source") or "fresh")
+
+
+def diff_sidecars(old: dict, new: dict, threshold: float) -> dict:
+    """Compare two sidecar documents. Returns
+    {rows: [...], regressions: [...], notes: [...], comparable: bool}.
+
+    A row REGRESSES when its fractional delta moves in the bad direction
+    by more than `threshold` (e.g. wallclock +12% at threshold 0.10).
+    Rows whose old value is 0 are skipped (no stable base)."""
+    notes = []
+    po, pn = _provenance(old), _provenance(new)
+    comparable = po == pn
+    if not comparable:
+        notes.append(f"provenance mismatch: old={po} new={pn} — scales "
+                     "differ, deltas reported but NOT gated")
+    for side, doc in (("old", old), ("new", new)):
+        if doc.get("degraded"):
+            notes.append(f"{side} run was DEGRADED (retries/OOM ladder) — "
+                         "not a like-for-like baseline")
+    rows_old = extract_rows(old)
+    rows_new = extract_rows(new)
+    out_rows = []
+    regressions = []
+    for name in sorted(set(rows_old) & set(rows_new)):
+        v_old, direction = rows_old[name]
+        v_new = rows_new[name][0]
+        if v_old == 0:
+            continue
+        delta = (v_new - v_old) / abs(v_old)
+        bad = delta if direction == "lower" else -delta
+        regressed = comparable and bad > threshold
+        row = {"row": name, "old": v_old, "new": v_new,
+               "delta_frac": delta, "direction": direction,
+               "regressed": regressed}
+        out_rows.append(row)
+        if regressed:
+            regressions.append(row)
+    only_old = sorted(set(rows_old) - set(rows_new))
+    only_new = sorted(set(rows_new) - set(rows_old))
+    if only_old:
+        notes.append(f"rows only in old (skipped): {only_old}")
+    if only_new:
+        notes.append(f"rows only in new (skipped): {only_new}")
+    return {"rows": out_rows, "regressions": regressions, "notes": notes,
+            "comparable": comparable}
+
+
+def format_diff(result: dict, label: str = "", threshold: float = 0.1
+                ) -> str:
+    lines = []
+    head = f"bench diff{f' [{label}]' if label else ''} " \
+           f"(threshold {threshold:.0%}):"
+    lines.append(head)
+    for note in result["notes"]:
+        lines.append(f"  ! {note}")
+    for row in result["rows"]:
+        arrow = "REGRESSED" if row["regressed"] else (
+            "improved" if (row["delta_frac"] < 0) == (
+                row["direction"] == "lower") and row["delta_frac"] != 0
+            else "~")
+        lines.append(
+            f"  {row['row']:60s} {row['old']:>12.4g} -> "
+            f"{row['new']:>12.4g}  {row['delta_frac']:+.1%}  [{arrow}]")
+    n = len(result["regressions"])
+    lines.append(f"  {n} regression(s)" if n else "  no regressions")
+    return "\n".join(lines)
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _pairs(old_dir: str, new_dir: str):
+    """Matching `telemetry_config*.json` names across two run dirs."""
+    names_old = {os.path.basename(p) for p in glob.glob(
+        os.path.join(old_dir, "telemetry_config*.json"))}
+    names_new = {os.path.basename(p) for p in glob.glob(
+        os.path.join(new_dir, "telemetry_config*.json"))}
+    for name in sorted(names_old & names_new):
+        yield name, os.path.join(old_dir, name), os.path.join(new_dir, name)
+    for name in sorted(names_old ^ names_new):
+        where = "old" if name in names_old else "new"
+        print(f"[bench_diff] {name} present only in {where} — skipped",
+              file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two bench telemetry sidecars (or run dirs) "
+                    "with a regression threshold.")
+    ap.add_argument("old", help="baseline sidecar .json (or directory)")
+    ap.add_argument("new", help="candidate sidecar .json (or directory)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="fractional regression gate (default 0.10)")
+    args = ap.parse_args(argv)
+
+    try:
+        if os.path.isdir(args.old) and os.path.isdir(args.new):
+            jobs = list(_pairs(args.old, args.new))
+            if not jobs:
+                # a gate that compared NOTHING must not read as green —
+                # an empty/renamed artifact dir is a misconfiguration
+                print(f"[bench_diff] error: no matching "
+                      f"telemetry_config*.json pairs between {args.old} "
+                      f"and {args.new}", file=sys.stderr)
+                return 2
+        else:
+            jobs = [("", args.old, args.new)]
+        regressed = False
+        for label, p_old, p_new in jobs:
+            result = diff_sidecars(_load(p_old), _load(p_new),
+                                   args.threshold)
+            print(format_diff(result, label or os.path.basename(p_new),
+                              args.threshold))
+            regressed = regressed or bool(result["regressions"])
+    except (OSError, ValueError) as e:
+        print(f"[bench_diff] error: {e}", file=sys.stderr)
+        return 2
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

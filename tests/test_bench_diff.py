@@ -1,0 +1,131 @@
+"""scripts/bench_diff.py — the perf-trajectory gate's deterministic
+self-test: an injected regression is flagged past the threshold,
+improvements and schema growth are not, provenance mismatches are
+reported but never gated, and the directory mode pairs sidecars by
+name. Pure JSON arithmetic — no jax, no engine."""
+
+import copy
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "scripts"))
+
+import bench_diff  # noqa: E402
+
+
+def _sidecar(wallclock=10.0, samples_per_s=3000.0, hit_rate=0.5,
+             source="fresh", degraded=False, with_device=True):
+    doc = {
+        "metric": "exact_shapley_mnist_10partners_8epochs_wallclock",
+        "wallclock_s": wallclock,
+        "source": source,
+        "degraded": degraded,
+        "report": {
+            "wallclock": {"evaluate_s": wallclock * 0.9,
+                          "compile_s": 1.0, "prep_s": 0.1,
+                          "dispatch_s": 5.0, "harvest_s": 0.5},
+            "memo": {"requested": 100, "hits": 50, "misses": 50,
+                     "hit_rate": hit_rate},
+            "batches": {"count": 10, "coalitions": 80, "padding": 20,
+                        "pad_waste_fraction": 0.2},
+            "compute": {"samples_per_s": samples_per_s,
+                        "mfu_proxy": 0.3, "mfu_xla": 0.4},
+            "resilience": {"retries": 0, "cap_halvings": 0},
+            "per_width": [{"slot_count": 3, "width": 16,
+                           "coalitions_per_s": 6.0}],
+        },
+    }
+    if with_device:
+        doc["report"]["device_time"] = {"device_s": wallclock * 0.5}
+        doc["report"]["roofline"] = {"programs": [
+            {"slot_count": 3, "width": 16,
+             "achieved_flops_per_s": 2e12}]}
+    return doc
+
+
+def test_identical_sidecars_have_no_regressions():
+    result = bench_diff.diff_sidecars(_sidecar(), _sidecar(), 0.10)
+    assert result["comparable"] is True
+    assert result["regressions"] == []
+    assert all(r["delta_frac"] == 0 for r in result["rows"])
+
+
+def test_injected_regression_is_flagged():
+    old, new = _sidecar(), _sidecar(wallclock=15.0)   # +50% wall-clock
+    result = bench_diff.diff_sidecars(old, new, 0.10)
+    regressed = {r["row"] for r in result["regressions"]}
+    assert "wallclock_s" in regressed
+    assert "report.wallclock.evaluate_s" in regressed
+    assert "report.device_time.device_s" in regressed
+    text = bench_diff.format_diff(result, "self-test", 0.10)
+    assert "REGRESSED" in text
+
+
+def test_direction_awareness_and_threshold():
+    # higher-is-better metrics regress when they DROP past the gate...
+    old, new = _sidecar(), _sidecar(samples_per_s=1500.0, hit_rate=0.1)
+    regressed = {r["row"] for r in
+                 bench_diff.diff_sidecars(old, new, 0.10)["regressions"]}
+    assert "report.compute.samples_per_s" in regressed
+    assert "report.memo.hit_rate" in regressed
+    # ...improvements in the good direction are never flagged
+    better = _sidecar(wallclock=5.0, samples_per_s=6000.0)
+    assert not bench_diff.diff_sidecars(_sidecar(), better,
+                                        0.10)["regressions"]
+    # ...and a drift inside the threshold passes
+    close = _sidecar(wallclock=10.5)
+    assert not bench_diff.diff_sidecars(_sidecar(), close,
+                                        0.10)["regressions"]
+
+
+def test_schema_growth_is_not_a_regression():
+    """A pre-devcost sidecar vs one with device/roofline rows: rows
+    present on only one side are skipped (noted), never gated."""
+    old = _sidecar(with_device=False)
+    result = bench_diff.diff_sidecars(old, _sidecar(), 0.10)
+    assert not result["regressions"]
+    assert any("only in new" in n for n in result["notes"])
+
+
+def test_provenance_mismatch_reports_but_never_gates():
+    old = _sidecar()
+    new = _sidecar(wallclock=100.0, source="cpu_fallback")
+    result = bench_diff.diff_sidecars(old, new, 0.10)
+    assert result["comparable"] is False
+    assert not result["regressions"]
+    assert any("provenance mismatch" in n for n in result["notes"])
+    deg = bench_diff.diff_sidecars(_sidecar(degraded=True), _sidecar(),
+                                   0.10)
+    assert any("DEGRADED" in n for n in deg["notes"])
+
+
+def test_main_exit_codes_and_dir_mode(tmp_path, capsys):
+    old_dir, new_dir = tmp_path / "rA", tmp_path / "rB"
+    old_dir.mkdir(), new_dir.mkdir()
+    (old_dir / "telemetry_config1.json").write_text(
+        json.dumps(_sidecar()))
+    (new_dir / "telemetry_config1.json").write_text(
+        json.dumps(_sidecar(wallclock=20.0)))
+    # a file on one side only is skipped, not fatal
+    (new_dir / "telemetry_config6.json").write_text(
+        json.dumps(_sidecar()))
+    assert bench_diff.main([str(old_dir), str(new_dir)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "regression(s)" in out
+    # same files -> clean gate
+    same = copy.deepcopy(_sidecar())
+    (new_dir / "telemetry_config1.json").write_text(json.dumps(same))
+    assert bench_diff.main([str(old_dir), str(new_dir)]) == 0
+    # unreadable input -> usage error, not a traceback
+    assert bench_diff.main([str(old_dir / "missing.json"),
+                            str(new_dir / "telemetry_config1.json")]) == 2
+
+
+def test_dir_mode_with_zero_pairs_errors_instead_of_passing(tmp_path,
+                                                            capsys):
+    """An empty/renamed artifact dir must not read as a green gate."""
+    a, b = tmp_path / "empty_a", tmp_path / "empty_b"
+    a.mkdir(), b.mkdir()
+    assert bench_diff.main([str(a), str(b)]) == 2
+    assert "no matching" in capsys.readouterr().err

@@ -31,7 +31,8 @@ from mplc_tpu.service import scheduler as sched
 @pytest.fixture(autouse=True)
 def _clean(monkeypatch):
     for k in ("MPLC_TPU_METRICS_PORT", "MPLC_TPU_SERVICE_FAULT_PLAN",
-              "MPLC_TPU_FAULT_PLAN", "MPLC_TPU_MAX_RETRIES"):
+              "MPLC_TPU_FAULT_PLAN", "MPLC_TPU_MAX_RETRIES",
+              "MPLC_TPU_METRICS_TOKEN"):
         monkeypatch.delenv(k, raising=False)
     monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
     metrics.reset()
@@ -340,3 +341,133 @@ def test_flight_dump_never_raises(monkeypatch):
     monkeypatch.setenv("MPLC_TPU_FLIGHT_RECORDER_DIR",
                        "/proc/definitely/not/writable")
     assert flight.dump("test_reason") is None
+
+
+# -- bearer-token auth + tenant redaction (MPLC_TPU_METRICS_TOKEN) ------------
+
+def _get_auth(url, token=None):
+    req = urllib.request.Request(url)
+    if token is not None:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        resp = urllib.request.urlopen(req, timeout=10)
+        return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_token_gates_metrics_and_varz_but_not_healthz(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_METRICS_PORT", "0")
+    monkeypatch.setenv("MPLC_TPU_METRICS_TOKEN", "s3cret")
+    svc = SweepService(start=False)
+    try:
+        base = f"http://127.0.0.1:{export.active_server().port}"
+        jobA = svc.submit(_scenario(0), tenant="tenantA")
+        jobB = svc.submit(_scenario(1), tenant="tenantB")
+        svc.run_until_idle()
+        assert jobA.status == jobB.status == "completed"
+
+        # no token / wrong token -> 401 on the data endpoints; a
+        # non-ASCII header must 401 too, never TypeError the handler
+        for url in ("/metrics", "/varz"):
+            assert _get_auth(base + url)[0] == 401
+            assert _get_auth(base + url, token="wrong")[0] == 401
+            assert _get_auth(base + url, token="ümlaut")[0] == 401
+        # liveness probes stay open (a 401ing health check reads "down")
+        assert _get_auth(base + "/healthz")[0] in (200, 503)
+
+        # the MASTER token is the operator credential: full /metrics and
+        # a full, unredacted /varz
+        status, text = _get_auth(base + "/metrics", token="s3cret")
+        assert status == 200 and "mplc_service_jobs_completed" in text
+        status, body = _get_auth(base + "/varz", token="s3cret")
+        assert status == 200 and "tenantB" in body
+        assert "redacted" not in body
+
+        # the per-tenant credential authenticates the viewer claim: own
+        # rows full, every other tenant redacted
+        tokA = export.tenant_token("s3cret", "tenantA")
+        status, body = _get_auth(base + "/varz?tenant=tenantA",
+                                 token=tokA)
+        assert status == 200
+        jobs = json.loads(body)[svc._provider_key]["jobs"]
+        rows = {r["tenant"]: r for r in jobs.values()}
+        assert "tenantA" in rows and not rows["tenantA"].get("redacted")
+        assert "tenantB" not in rows          # identity hashed away
+        redacted = [r for r in jobs.values() if r.get("redacted")]
+        assert redacted and redacted[0]["tenant"].startswith("tenant-")
+        assert "method" not in redacted[0]    # work detail dropped
+        assert "status" in redacted[0]        # scheduling facts kept
+        # the raw tenant name must not appear anywhere in the body
+        assert "tenantB" not in body
+
+        # the viewer claim cannot be forged: tenant A's credential with
+        # ?tenant=tenantB (or no claim at all) is denied, and a tenant
+        # credential never unlocks the unredacted /metrics text
+        assert _get_auth(base + "/varz?tenant=tenantB",
+                         token=tokA)[0] == 401
+        assert _get_auth(base + "/varz", token=tokA)[0] == 401
+        assert _get_auth(base + "/metrics?tenant=tenantA",
+                         token=tokA)[0] == 401
+    finally:
+        svc.shutdown(drain=False)
+
+
+def test_unset_token_leaves_endpoints_open(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_METRICS_PORT", "0")
+    svc = SweepService(start=False)
+    try:
+        base = f"http://127.0.0.1:{export.active_server().port}"
+        assert _get(base + "/metrics")[0] == 200
+        status, body = _get(base + "/varz")
+        assert status == 200
+        # no token -> no redaction marker anywhere
+        assert "redacted" not in body
+    finally:
+        svc.shutdown(drain=False)
+
+
+def test_redact_varz_rewrites_tenant_metric_labels():
+    doc = {
+        "metrics": {"counters": {
+            'service.device_seconds{tenant=alice}': 1.5,
+            'service.device_seconds{tenant=bob}': 2.5,
+            "engine.retries": 0}},
+        "svc": {"jobs": {"job1": {"tenant": "alice", "status": "running",
+                                  "priority": 1, "age_sec": 2.0,
+                                  "method": "Shapley values"}},
+                "tenant_device_seconds": {"alice": 1.5, "bob": 2.5}},
+    }
+    out = export.redact_varz(doc, viewer="alice")
+    counters = out["metrics"]["counters"]
+    assert 'service.device_seconds{tenant=alice}' in counters
+    assert 'service.device_seconds{tenant=bob}' not in counters
+    assert counters["engine.retries"] == 0      # unlabeled keys untouched
+    assert out["svc"]["jobs"]["job1"]["method"] == "Shapley values"
+    tds = out["svc"]["tenant_device_seconds"]
+    assert tds["alice"] == 1.5 and "bob" not in tds
+    assert sum(v == 2.5 for v in tds.values()) == 1  # value kept, key hashed
+    # a different viewer sees alice redacted instead — including the
+    # caller-supplied job id, which is hashed out of the row KEY
+    out2 = export.redact_varz(doc, viewer="bob")
+    rows2 = out2["svc"]["jobs"]
+    assert "job1" not in rows2
+    (jid, red), = rows2.items()
+    assert jid.startswith("job-") and red["redacted"] is True
+
+
+def test_redact_health_hashes_job_ids():
+    doc = {"healthy": True, "running_job": "acme-payroll-q3",
+           "running_jobs": ["acme-payroll-q3", None],
+           "providers": {"svc": {"workers": [
+               {"worker": 0, "running_job": "acme-payroll-q3",
+                "stalled": False}]}},
+           "queue_depth": 3}
+    out = export.redact_health(doc, key="tok")
+    assert out["running_job"].startswith("job-")
+    assert out["running_jobs"][0].startswith("job-")
+    assert out["running_jobs"][1] is None
+    worker = out["providers"]["svc"]["workers"][0]
+    assert worker["running_job"].startswith("job-")
+    assert worker["stalled"] is False and out["queue_depth"] == 3
+    assert "acme-payroll-q3" not in json.dumps(out)
