@@ -26,6 +26,14 @@ Configs (select with BENCH_CONFIG, default "1"):
      terminal, completed tenants bit-identical to solo runs).
      MPLC_TPU_SERVICE_WORKERS / _SHED_P99_SEC / _MAX_PENDING apply;
      the first benchmark of the system AS a service under load
+  8  live contributivity tier (mplc_tpu/live/): one recorded game kept
+     RESIDENT, its rounds re-appended as live aggregation rounds up to
+     BENCH_LIVE_ROUNDS (default 4x the recording) — at each doubling of the resident
+     history a fresh GTG query (round-stamp invalidated) and a warm
+     (memoized) re-query are timed, so the sidecar's live block shows
+     query latency vs resident rounds and the memo/banked warm path.
+     The emitted metric is the final fresh-query latency at max
+     residency (MPLC_TPU_LIVE_PRUNE_TAU / _MAX_ROUNDS apply)
 
 Workload notes. The reference (saved_experiments results.csv) trains ONE
 fedavg MNIST model in ~589 s wall-clock at 50 epochs and needs one full
@@ -223,6 +231,11 @@ _WORKLOAD_KNOBS = (
     "MPLC_TPU_DONATE_BUFFERS", "MPLC_TPU_PROGRAM_BANK",
     "MPLC_TPU_EVAL_CHUNK", "MPLC_TPU_FAULT_PLAN",
     "MPLC_TPU_GTG_TRUNCATION",
+    # the live-tier knobs change which coalitions a live query evaluates
+    # (pruning), how deep reconstruction replays (round cap) and which
+    # queries survive (deadline) — a different live workload entirely
+    "MPLC_TPU_LIVE_MAX_ROUNDS", "MPLC_TPU_LIVE_PRUNE_TAU",
+    "MPLC_TPU_LIVE_QUERY_DEADLINE_SEC",
     "MPLC_TPU_MAX_CAP_HALVINGS", "MPLC_TPU_MAX_RETRIES",
     "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_FAULT_PLAN",
     "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
@@ -889,6 +902,79 @@ def bench_load(epochs, dtype):
     _emit(metric, elapsed, 0.0)
 
 
+def bench_live(epochs, dtype):
+    """Config 8: the live contributivity tier. One grand-coalition
+    recording seeds a RESIDENT LiveGame; its recorded rounds are then
+    re-appended (cycled) as live aggregation rounds, and at every
+    doubling of the resident history a FRESH query (the append
+    invalidated the round-stamp, so reconstruction replays the whole
+    stack) and a WARM re-query (memo + banked programs, zero device
+    work) are timed. The sidecar's live block is the headline artifact:
+    query latency vs resident rounds, memo-hit latency, evaluation and
+    pruning counts. The emitted metric is the final fresh-query latency
+    at max residency."""
+    from mplc_tpu.live import LiveGame
+    from mplc_tpu.obs import trace as obs_trace
+    from mplc_tpu.obs.report import format_report, sweep_report
+
+    dataset = os.environ.get("BENCH_DATASET", "mnist")
+    n_partners = int(os.environ.get("BENCH_PARTNERS", "10"))
+    # truncation off: every permutation prefix reconstructs, so the
+    # fresh-query latency honestly scales with the resident history
+    method_kw = dict(sv_accuracy=1.0, min_iter=16, perm_batch=8,
+                     truncation=0.0)
+
+    sc = _make_scenario(dataset, n_partners, epochs, dtype)
+    print("[bench] recording the grand coalition for the live game...",
+          file=sys.stderr, flush=True)
+    with obs_trace.collect() as tele:
+        t_all = time.perf_counter()
+        game = LiveGame.from_recording(sc)
+        base = game.round_history()
+        # default residency target: 4x the recording (BENCH_LIVE_ROUNDS
+        # overrides) — the recording length is epochs x minibatches, so
+        # a fixed default would sit below the starting residency
+        max_rounds = (int(os.environ.get("BENCH_LIVE_ROUNDS", "0"))
+                      or 4 * game.rounds_resident)
+        _beat()
+        points = []
+        i = 0
+        last_fresh = None
+        while True:
+            t0 = time.perf_counter()
+            r = game.query("GTG-Shapley", **method_kw)
+            fresh_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            game.query("GTG-Shapley", **method_kw)  # warm: memoized
+            warm_s = time.perf_counter() - t0
+            last_fresh = fresh_s
+            points.append({"rounds": game.rounds_resident,
+                           "fresh_query_s": fresh_s,
+                           "warm_query_s": warm_s,
+                           "evaluations": r.evaluations})
+            print(f"[bench] live: rounds={game.rounds_resident} "
+                  f"fresh={fresh_s:.3f}s warm={warm_s * 1e3:.2f}ms "
+                  f"evals={r.evaluations}", file=sys.stderr, flush=True)
+            _beat()
+            if game.rounds_resident >= max_rounds:
+                break
+            # double the resident history by cycling the recorded rounds
+            target = min(max_rounds, 2 * game.rounds_resident)
+            while game.rounds_resident < target:
+                deltas, weights = base[i % len(base)]
+                game.append_round(deltas, weights)
+                i += 1
+        elapsed = time.perf_counter() - t_all
+    rep = sweep_report(tele)
+    print(format_report(rep), file=sys.stderr, flush=True)
+    metric = (f"live_query_{dataset}_{n_partners}partners_"
+              f"{max_rounds}rounds_latency")
+    _write_telemetry({"metric": metric, "wallclock_s": elapsed,
+                      "devices": _ndev(), "degraded": _degraded_run(rep),
+                      "latency_vs_rounds": points, "report": rep})
+    _emit(metric, last_fresh, 0.0)
+
+
 def _bench_method(dataset_name, n_partners, method, epochs, dtype,
                   corrupted=None, extra_methods=()):
     """Shared driver for the MC/IS/stratified configs: run
@@ -1015,8 +1101,10 @@ def main():
         bench_service(epochs, dtype)
     elif config == "7":
         bench_load(epochs, dtype)
+    elif config == "8":
+        bench_live(epochs, dtype)
     else:
-        raise SystemExit(f"unknown BENCH_CONFIG={config!r} (use 1-7)")
+        raise SystemExit(f"unknown BENCH_CONFIG={config!r} (use 1-8)")
 
     if _watchdog_fired.is_set():
         # The watchdog declared this run dead and its fallback child owns

@@ -229,6 +229,35 @@ COMPILE_CACHE_DIR_ENV = "MPLC_TPU_COMPILE_CACHE_DIR"
 GTG_TRUNCATION_ENV = "MPLC_TPU_GTG_TRUNCATION"
 SVARM_SAMPLES_ENV = "MPLC_TPU_SVARM_SAMPLES"
 
+# Live contributivity tier (mplc_tpu/live/): resident incremental games
+# answering "what is my Shapley value NOW" from recorded-round
+# reconstruction, with DPVS-style dynamic coalition pruning:
+#   MPLC_TPU_LIVE_PRUNE_TAU    DPVS pruning threshold tau in [0, 1]
+#                              (read at query time, warn+fallback): a
+#                              partner whose recorded-round information
+#                              score falls below tau x the max partner
+#                              score is pruned — coalitions differing
+#                              only by pruned partners collapse onto one
+#                              evaluated representative. 0 (the default)
+#                              = pruning OFF, queries bit-identical to
+#                              the unpruned reconstruction path (the
+#                              exactness-preserving off switch).
+#   MPLC_TPU_LIVE_MAX_ROUNDS   resident-round cap per live game (4096,
+#                              read at game construction): append_round
+#                              past it raises LiveGameFull instead of
+#                              letting one tenant's history grow device
+#                              reconstruction cost and journal size
+#                              without bound.
+#   MPLC_TPU_LIVE_QUERY_DEADLINE_SEC
+#                              default deadline for live-query jobs
+#                              submitted through the sweep service's
+#                              low-latency class (submit_live); 0/unset
+#                              = no default deadline. An explicit
+#                              deadline_sec argument wins.
+LIVE_PRUNE_TAU_ENV = "MPLC_TPU_LIVE_PRUNE_TAU"
+LIVE_MAX_ROUNDS_ENV = "MPLC_TPU_LIVE_MAX_ROUNDS"
+LIVE_QUERY_DEADLINE_ENV = "MPLC_TPU_LIVE_QUERY_DEADLINE_SEC"
+
 # Sweep service (mplc_tpu/service/): the long-lived multi-tenant
 # scheduler — bounded submission queue, round-robin slicing across
 # tenants, per-tenant fault isolation, journaled crash recovery. All
@@ -370,6 +399,14 @@ ENV_KNOBS = {
     "MPLC_TPU_EVAL_CHUNK": "workload",
     "MPLC_TPU_GTG_TRUNCATION": "workload",
     "MPLC_TPU_SVARM_SAMPLES": "workload",
+    # the live-tier knobs shape what a live-query bench run computes and
+    # pays: the pruning threshold changes which coalitions are evaluated
+    # at all, the resident-round cap bounds the reconstruction depth, and
+    # the default query deadline decides which queries survive — none may
+    # leak into a cached replay or the CPU-fallback child
+    "MPLC_TPU_LIVE_PRUNE_TAU": "workload",
+    "MPLC_TPU_LIVE_MAX_ROUNDS": "workload",
+    "MPLC_TPU_LIVE_QUERY_DEADLINE_SEC": "workload",
     "MPLC_TPU_FAULT_PLAN": "workload",
     "MPLC_TPU_MAX_CAP_HALVINGS": "workload",
     "MPLC_TPU_MAX_RETRIES": "workload",

@@ -297,17 +297,7 @@ class ProgramBank:
         finally:
             # publish UNCONDITIONALLY — a waiter blocked on the in-flight
             # event must never hang because the compiling thread died
-            with _LOCK:
-                _PROGRAMS[key] = (entry if entry is not None
-                                  else RuntimeError("bank compile aborted"))
-                ev = _INFLIGHT.pop(key, None)
-                # FIFO bound on the global store (oldest first; dicts are
-                # insertion-ordered). In-flight users keep their bundle
-                # alive through their own reference.
-                while len(_PROGRAMS) > _MAX_PROGRAMS:
-                    _PROGRAMS.pop(next(iter(_PROGRAMS)))
-            if ev is not None:
-                ev.set()
+            self._publish(key, entry)
         dur = time.perf_counter() - t0
         if ok:
             obs_metrics.counter("bank.compiles").inc()
@@ -336,7 +326,54 @@ class ProgramBank:
             _INFLIGHT[key] = threading.Event()
             return None, None, True
 
+    @staticmethod
+    def _publish(key, entry) -> None:
+        """Publish a compile result — a bundle dict or the failure
+        tombstone — to the global store and release the in-flight claim:
+        the ONE place the tombstone/FIFO-evict/event-release protocol
+        lives (training bundles and recon programs both go through it).
+        `entry=None` (the compiling thread died before producing either)
+        publishes an explicit tombstone so waiters never hang."""
+        with _LOCK:
+            _PROGRAMS[key] = (entry if entry is not None
+                              else RuntimeError("bank compile aborted"))
+            ev = _INFLIGHT.pop(key, None)
+            # FIFO bound on the global store (oldest first; dicts are
+            # insertion-ordered). In-flight users keep their bundle
+            # alive through their own reference.
+            while len(_PROGRAMS) > _MAX_PROGRAMS:
+                _PROGRAMS.pop(next(iter(_PROGRAMS)))
+        if ev is not None:
+            ev.set()
+
     # -- the two engine-facing operations --------------------------------
+
+    def _acquire_entry(self, key, compile_owner, slot_count, width):
+        """The claim/wait/hit protocol shared by `acquire` and
+        `acquire_recon`: exactly one thread owns a key's compile
+        (`compile_owner()` runs it in the caller's thread); everyone
+        else waits on the in-flight event. The wait is SERIAL
+        wall-clock: on a cold bank where execution outruns the
+        background compiler the stall can span several programs'
+        compiles — it is emitted as a `bank.wait` span so the sweep
+        report books it as serial compile stall instead of letting the
+        worker's overlapped=True events claim the time never blocked
+        anyone. The timeout is a belt-and-braces bound (owners publish
+        in a finally); on expiry the caller just takes the inline jit
+        path. A bundle served with no compile and no wait counts as a
+        bank hit (failed-compile tombstones are NOT hits — the sweep is
+        actually compiling inline for that program)."""
+        entry, ev, owner = self._claim(key)
+        if owner:
+            compile_owner()
+        elif ev is not None:
+            with obs_trace.span("bank.wait", slot_count=slot_count,
+                                width=int(width)):
+                ev.wait(timeout=600)
+        entry = _PROGRAMS.get(key)
+        if not owner and ev is None and isinstance(entry, dict):
+            obs_metrics.counter("bank.hits").inc()
+        return entry if isinstance(entry, dict) else None
 
     def acquire(self, pipe, slot_count, width):
         """The executable bundle for one bucket, compiling in the CALLER's
@@ -347,30 +384,11 @@ class ProgramBank:
         if not bank_enabled() or not pipe.dispatches_async:
             return None
         key = self.program_key(pipe, slot_count, width)
-        entry, ev, owner = self._claim(key)
-        if owner:
-            self._do_compile(key, pipe, slot_count, width, overlapped=False)
-        elif ev is not None:
-            # a background (or concurrent) compile owns the key. This
-            # wait is SERIAL wall-clock: the single worker drains its
-            # queue in order, so on a cold bank where execution outruns
-            # compilation the stall can span several programs' compiles
-            # — emit it as a bank.wait span so the sweep report books it
-            # as serial compile stall instead of letting the worker's
-            # overlapped=True events claim the time never blocked
-            # anyone. The timeout is a belt-and-braces bound (the owner
-            # publishes in a finally); on expiry the caller just takes
-            # the inline jit path.
-            with obs_trace.span("bank.wait", slot_count=slot_count,
-                                width=int(width)):
-                ev.wait(timeout=600)
-        entry = _PROGRAMS.get(key)
-        if not owner and ev is None and isinstance(entry, dict):
-            # a true bank hit: served from the store with no compile and
-            # no wait (failed-compile tombstones are NOT hits — the
-            # sweep is actually compiling inline for that program)
-            obs_metrics.counter("bank.hits").inc()
-        return entry if isinstance(entry, dict) else None
+        return self._acquire_entry(
+            key,
+            lambda: self._do_compile(key, pipe, slot_count, width,
+                                     overlapped=False),
+            slot_count, width)
 
     def prefetch(self, plan) -> None:
         """Background-compile every bucket AFTER the first: while bucket k
@@ -400,6 +418,95 @@ class ProgramBank:
 
         threading.Thread(target=worker, daemon=True,
                          name="mplc-program-bank").start()
+
+    # -- reconstruction eval programs (the live tier's warm path) --------
+
+    def recon_key(self, evaluator, width: int) -> str:
+        """Identity of one fused reconstruct+eval executable: the engine
+        digest (SHAPE-scoped under `shared=True`, so two tenants of the
+        same shape — or a restarted live game — share programs), the
+        recorded-round count (the scan length is baked into the
+        program), the mask width, the donation signature and the
+        topology."""
+        rec = evaluator.recorded
+        eng = self.engine
+        from ..mpl.engine import buffer_donation_enabled
+        donates = getattr(evaluator, "_fn_donates", None)
+        if donates is None:
+            donates = buffer_donation_enabled()
+        n_dev = eng._sharding.num_devices if eng._sharding else 1
+        raw = json.dumps([self._engine_digest(), "recon",
+                          int(rec.weights.shape[0]), eng.partners_count,
+                          int(width), bool(donates), n_dev,
+                          jax.default_backend()])
+        return hashlib.sha256(raw.encode()).hexdigest()[:24]
+
+    def _compile_recon_bundle(self, evaluator, width: int) -> dict:
+        """AOT-lower + compile the evaluator's fused reconstruct+eval
+        program for one mask width. The recorded stream and test set are
+        lowered from the CONCRETE arrays (capturing their live
+        shardings); the per-batch mask argument is a ShapeDtypeStruct
+        carrying the engine's batch sharding, exactly what the dispatch
+        closure device_puts."""
+        import jax.numpy as jnp
+        eng = self.engine
+        rec = evaluator.recorded
+        sh = eng._sharding.batch_sharding if eng._sharding else None
+        if sh is not None:
+            masks = jax.ShapeDtypeStruct((int(width), eng.partners_count),
+                                         jnp.float32, sharding=sh)
+        else:
+            masks = jax.ShapeDtypeStruct((int(width), eng.partners_count),
+                                         jnp.float32)
+        fn = evaluator._batch_eval_fn()
+        return {"recon": fn.lower(masks, rec.init_params, rec.deltas,
+                                  rec.weights, eng.test).compile()}
+
+    def _do_compile_recon(self, key, evaluator, width: int) -> None:
+        """The recon analog of `_do_compile`: compile under an exclusive
+        in-flight claim, publish through the shared protocol, account the
+        compile (one program, never overlapped — recon compiles happen
+        in the querying caller's thread) and record the manifest key."""
+        t0 = time.perf_counter()
+        entry = None
+        ok = False
+        try:
+            try:
+                entry = self._compile_recon_bundle(evaluator, width)
+                ok = True
+            except Exception as e:
+                logger.warning(
+                    "program-bank recon compile failed for width=%s — "
+                    "falling back to inline jit compilation: %s",
+                    width, e)
+                entry = e
+        finally:
+            self._publish(key, entry)
+        if ok:
+            dur = time.perf_counter() - t0
+            obs_metrics.counter("bank.compiles").inc()
+            obs_metrics.counter("bank.compile_seconds").inc(dur)
+            obs_trace.event(
+                "bank.compile", dur=dur, slot_count=None,
+                width=int(width), overlapped=False,
+                donation=getattr(evaluator, "_fn_donates", False),
+                programs=1, recon=True)
+            self._record_manifest(key)
+
+    def acquire_recon(self, evaluator, width: int):
+        """The banked executable for one reconstruction batch width (or
+        None — inline jit path — when the bank is disabled or the
+        compile failed). Same claim/wait/publish/hit protocol as
+        `acquire` (`_acquire_entry`); compiled keys land in the
+        persistent manifest, so a fresh process can prove it already
+        holds a live game's programs."""
+        if not bank_enabled():
+            return None
+        key = self.recon_key(evaluator, width)
+        entry = self._acquire_entry(
+            key, lambda: self._do_compile_recon(key, evaluator, width),
+            None, width)
+        return entry.get("recon") if entry is not None else None
 
     # -- persistence (the manifest that makes the cache dir a bank) ------
 

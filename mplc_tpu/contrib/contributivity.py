@@ -19,8 +19,16 @@ instead of training one subset at a time. Concretely:
     the estimator); the draws themselves come from tabulated/vectorized
     samplers (contrib/sampling.py) instead of the reference's O(2^(n-1))
     power-set walk per draw;
-  - the stratified methods stay iteration-sequential (their allocation is
-    adaptive) but batch the n (S, S u {k}) pairs inside each iteration.
+  - the stratified methods keep their per-iteration adaptive allocation
+    rule bit-identically (the fixed-seed oracle pins in
+    tests/test_estimator_regression.py) but batch through the engine with
+    a *speculative lookahead*: each iteration's evaluate() call also
+    carries the next `lookahead` iterations' draws, simulated on a CLONED
+    rng under the current allocation, so consecutive iterations' (S,
+    S u {k}) pairs pack into one device batch. v(S) is batch-invariant
+    and memoized, so a missed speculation only warms the memo — it can
+    never change the estimator's stream (lookahead=0 restores the
+    strictly sequential evaluation schedule).
 
 Reference quirks handled deliberately (see also SURVEY.md §7):
   - ITMCS's `size_of_rest` iterates positions of the *unpermuted* partner
@@ -514,9 +522,73 @@ class Contributivity:
     # 8/9. stratified Monte-Carlo (with and without replacement)
     # ------------------------------------------------------------------
 
-    def Stratified_MC(self, sv_accuracy=0.01, alpha=0.95):
+    @staticmethod
+    def _smcs_e(t: int, N: int) -> float:
+        """SMCS's exploration/exploitation schedule (reference :739-741)."""
+        gamma, beta = 0.2, 0.0075
+        return (1 + 1 / (1 + np.exp(gamma / beta))
+                - 1 / (1 + np.exp(-(t - gamma * N) / (beta * N))))
+
+    def _spec_rng(self) -> np.random.Generator:
+        """A CLONE of the estimator rng continuing from its live state:
+        the stratified methods' speculative lookahead draws from it, so
+        speculation can never perturb the real stream (the fixed-seed
+        pins vs the sequential allocation rule stay bit-identical)."""
+        g = np.random.Generator(type(self._rng.bit_generator)())
+        g.bit_generator.state = self._rng.bit_generator.state
+        return g
+
+    def _smcs_draw_plan(self, rng, e, N, sigma2):
+        """One SMCS iteration's [(k, strata, S)] draw plan — the exact
+        reference draw sequence, parameterized over the generator so the
+        speculative lookahead can replay it on a cloned rng."""
+        plan = []
+        for k in range(N):
+            if np.sum(sigma2[k]) == 0:
+                p = np.repeat(1 / N, N)
+            else:
+                p = np.repeat(1 / N, N) * (1 - e) + sigma2[k] / np.sum(sigma2[k]) * e
+            strata = rng.choice(np.arange(N), 1, p=p)[0]
+            # uniform draw of a size-`strata` subset of N\{k}: the
+            # reference walks the C(N-1, strata) combinations summing a
+            # constant probability per step (contributivity.py:757-768);
+            # the walk's stopping index is just floor(u * C) — unrank it
+            # directly instead of enumerating.
+            u = rng.uniform()
+            list_k = np.delete(np.arange(N), k)
+            total = comb(N - 1, int(strata))
+            if total <= 2 ** 53:
+                idx = min(int(u * total), total - 1)
+            else:
+                # float inverse-CDF can't index strata larger than 2^53
+                idx = randbelow(rng, total)
+            S = np.array(list_k[unrank_combination(N - 1, int(strata), idx)],
+                         int)
+            plan.append((k, strata, S))
+        return plan
+
+    @staticmethod
+    def _pair_requests(plan) -> list:
+        reqs = []
+        for k, _strata, S in plan:
+            reqs.append(tuple(sorted(S.tolist() + [k])))
+            if len(S):
+                reqs.append(tuple(sorted(S.tolist())))
+        return reqs
+
+    def Stratified_MC(self, sv_accuracy=0.01, alpha=0.95, lookahead=4):
         """Stratified MC Shapley (reference :727-819): per-partner strata by
-        coalition size, adaptive allocation toward high-variance strata."""
+        coalition size, adaptive allocation toward high-variance strata.
+
+        The allocation rule stays per-iteration adaptive (bit-identical
+        to the sequential reference loop — the oracle pin in
+        tests/test_estimator_regression.py), but each iteration's
+        engine.evaluate call ALSO carries the next `lookahead`
+        iterations' draws, simulated on a cloned rng under the current
+        sigma2 — so consecutive iterations' pairs pack into one device
+        batch and the later iterations mostly hit the memo. A missed
+        speculation only warms the memo (v(S) is batch-invariant);
+        lookahead=0 restores the strictly sequential schedule."""
         t0 = self._method_span("Stratified MC Shapley")
         logger.info("# Launching Stratified MC Shapley")
         N = self._n
@@ -524,7 +596,6 @@ class Contributivity:
         if N == 1:
             self._finish("Stratified MC Shapley", np.array([v_all]), np.array([0.0]), t0)
             return
-        gamma, beta = 0.2, 0.0075
         t = 0
         sigma2 = np.zeros((N, N))
         mu = np.zeros((N, N))
@@ -533,37 +604,17 @@ class Contributivity:
         contributions = [[list() for _ in range(N)] for _ in range(N)]
         while np.any(continuer) or (1 - alpha) < v_max / sv_accuracy ** 2:
             t += 1
-            e = (1 + 1 / (1 + np.exp(gamma / beta))
-                 - 1 / (1 + np.exp(-(t - gamma * N) / (beta * N))))
-            plan = []
-            for k in range(N):
-                if np.sum(sigma2[k]) == 0:
-                    p = np.repeat(1 / N, N)
-                else:
-                    p = np.repeat(1 / N, N) * (1 - e) + sigma2[k] / np.sum(sigma2[k]) * e
-                strata = self._rng.choice(np.arange(N), 1, p=p)[0]
-                # uniform draw of a size-`strata` subset of N\{k}: the
-                # reference walks the C(N-1, strata) combinations summing a
-                # constant probability per step (contributivity.py:757-768);
-                # the walk's stopping index is just floor(u * C) — unrank it
-                # directly instead of enumerating.
-                u = self._rng.uniform()
-                list_k = np.delete(np.arange(N), k)
-                total = comb(N - 1, int(strata))
-                if total <= 2 ** 53:
-                    idx = min(int(u * total), total - 1)
-                else:
-                    # float inverse-CDF can't index strata larger than 2^53
-                    idx = randbelow(self._rng, total)
-                S = np.array(list_k[unrank_combination(N - 1, int(strata), idx)],
-                             int)
-                plan.append((k, strata, S))
-            # batch this iteration's 2N evaluations
-            reqs = []
-            for k, strata, S in plan:
-                reqs.append(tuple(sorted(S.tolist() + [k])))
-                if len(S):
-                    reqs.append(tuple(sorted(S.tolist())))
+            plan = self._smcs_draw_plan(self._rng, self._smcs_e(t, N), N,
+                                        sigma2)
+            # batch this iteration's 2N evaluations, plus the speculative
+            # lookahead's (cloned rng, frozen sigma2 — extra memo warmth,
+            # never a changed stream)
+            reqs = self._pair_requests(plan)
+            if lookahead:
+                srng = self._spec_rng()
+                for j in range(1, int(lookahead) + 1):
+                    reqs += self._pair_requests(self._smcs_draw_plan(
+                        srng, self._smcs_e(t + j, N), N, sigma2))
             self.engine.evaluate(reqs)
             vals = self.engine.charac_fct_values
             for k, strata, S in plan:
@@ -587,8 +638,45 @@ class Contributivity:
             v_max = np.max(var)
         self._finish("Stratified MC Shapley", shap, np.sqrt(var), t0)
 
-    def without_replacment_SMC(self, sv_accuracy=0.01, alpha=0.95):
-        """Without-replacement stratified MC (reference :823-938)."""
+    @staticmethod
+    def _clone_pool(pool: WithoutReplacementRanks) -> WithoutReplacementRanks:
+        clone = WithoutReplacementRanks(pool.total)
+        clone._moved = dict(pool._moved)
+        return clone
+
+    def _wr_draw_plan(self, rng, N, sigma2, continuer, pools):
+        """One WR_SMC iteration's [(k, strata, S)] draw plan — the exact
+        reference draw sequence over the PASSED continuer/pool state, so
+        the real loop mutates its live state while the speculative
+        lookahead replays on clones."""
+        plan = []
+        for k in range(N):
+            if np.any(continuer[k]):
+                p = np.array(continuer[k], float) / np.sum(continuer[k])
+            elif np.sum(sigma2[k]) == 0:
+                continue
+            else:
+                p = sigma2[k] / np.sum(sigma2[k])
+            strata = rng.choice(np.arange(N), 1, p=p)[0]
+            if pools[k][strata].total <= 0:  # __len__ caps at sys.maxsize
+                continuer[k][strata] = False
+                continue
+            rank = pools[k][strata].pop_random(rng)
+            list_k = np.delete(np.arange(N), k)
+            subset = tuple(int(i) for i in
+                           list_k[unrank_combination(N - 1, int(strata), rank)])
+            plan.append((k, strata, np.array(subset, int)))
+        return plan
+
+    def without_replacment_SMC(self, sv_accuracy=0.01, alpha=0.95,
+                               lookahead=4):
+        """Without-replacement stratified MC (reference :823-938). Same
+        speculative-lookahead batching as `Stratified_MC` — the
+        lookahead replays the draw sequence on a cloned rng with CLONED
+        without-replacement pools and continuer state, so the real
+        stream (and its pool mutations) is untouched and the fixed-seed
+        oracle pin holds bit-identically; lookahead=0 restores the
+        strictly sequential evaluation schedule."""
         t0 = self._method_span("WR_SMC Shapley")
         logger.info("# Launching WR_SMC Shapley")
         N = self._n
@@ -610,29 +698,17 @@ class Contributivity:
                   for strata in range(N)] for _ in range(N)]
         while np.any(continuer) or (1 - alpha) < v_max / sv_accuracy ** 2:
             t += 1
-            plan = []
-            for k in range(N):
-                if np.any(continuer[k]):
-                    p = np.array(continuer[k], float) / np.sum(continuer[k])
-                elif np.sum(sigma2[k]) == 0:
-                    continue
-                else:
-                    p = sigma2[k] / np.sum(sigma2[k])
-                strata = self._rng.choice(np.arange(N), 1, p=p)[0]
-                if pools[k][strata].total <= 0:  # __len__ caps at sys.maxsize
-                    continuer[k][strata] = False
-                    continue
-                rank = pools[k][strata].pop_random(self._rng)
-                list_k = np.delete(np.arange(N), k)
-                subset = tuple(int(i) for i in
-                               list_k[unrank_combination(N - 1, int(strata), rank)])
-                plan.append((k, strata, np.array(subset, int)))
-            if plan:
-                reqs = []
-                for k, strata, S in plan:
-                    reqs.append(tuple(sorted(S.tolist() + [k])))
-                    if len(S):
-                        reqs.append(tuple(sorted(S.tolist())))
+            plan = self._wr_draw_plan(self._rng, N, sigma2, continuer, pools)
+            reqs = self._pair_requests(plan)
+            if lookahead:
+                srng = self._spec_rng()
+                spools = [[self._clone_pool(p) for p in row]
+                          for row in pools]
+                scont = [list(row) for row in continuer]
+                for _ in range(int(lookahead)):
+                    reqs += self._pair_requests(self._wr_draw_plan(
+                        srng, N, sigma2, scont, spools))
+            if reqs:
                 self.engine.evaluate(reqs)
             vals = self.engine.charac_fct_values
             for k, strata, S in plan:

@@ -206,6 +206,13 @@ class ReconstructionEvaluator:
     span/event vocabulary — but each batch is one fused
     reconstruct-then-evaluate program instead of a training run."""
 
+    # opt-in AOT path (the live tier): _apply serves each (rounds, width)
+    # program from the engine's ProgramBank instead of the inline jit —
+    # same lowering, bit-identical values, zero inline compiles on a warm
+    # bank. Default off so the historical estimator path is byte-for-byte
+    # unchanged.
+    use_bank = False
+
     def __init__(self, engine, recorded: RecordedRun | None = None):
         _check_not_2d(engine)
         self.engine = engine
@@ -214,6 +221,17 @@ class ReconstructionEvaluator:
         self.values: dict[tuple, float] = {(): 0.0}
         self.reconstructions = 0
         self._fn = None
+        self._fn_donates = None
+        self._cpu_rec = None
+
+    def reset_recorded(self, recorded: RecordedRun) -> None:
+        """Swap in a new recorded stream (the live tier's round-stamp
+        invalidation): the memo is derived from the OLD stream and must
+        be dropped with it; the jitted program cache survives (jit
+        retraces per recorded-round count, and the AOT bank keys on
+        it)."""
+        self.recorded = recorded
+        self.values = {(): 0.0}
         self._cpu_rec = None
 
     # -- the fused reconstruct+eval program ------------------------------
@@ -253,15 +271,25 @@ class ReconstructionEvaluator:
             # the dispatch closure re-materializes masks from the host
             # array on every invocation (`_run_batch`).
             from ..mpl.engine import buffer_donation_enabled
+            self._fn_donates = buffer_donation_enabled()
             self._fn = jax.jit(
                 batch_eval,
-                donate_argnums=(0,) if buffer_donation_enabled() else ())
+                donate_argnums=(0,) if self._fn_donates else ())
         return self._fn
 
     def _apply(self, masks: jax.Array) -> jax.Array:
         rec = self.recorded
-        return self._batch_eval_fn()(masks, rec.init_params, rec.deltas,
-                                     rec.weights, self.engine.test)
+        fn = self._batch_eval_fn()
+        if self.use_bank and self.engine.program_bank is not None:
+            # live-tier warm path: the AOT-banked executable for exactly
+            # this (rounds, width) program — the same jit, pre-lowered
+            # (bit-identical values); None falls back to the inline jit
+            exe = self.engine.program_bank.acquire_recon(
+                self, int(masks.shape[0]))
+            if exe is not None:
+                fn = exe
+        return fn(masks, rec.init_params, rec.deltas,
+                  rec.weights, self.engine.test)
 
     def _apply_cpu(self, masks: np.ndarray) -> jax.Array:
         """Terminal OOM-ladder rung: reconstruct+evaluate on the host CPU

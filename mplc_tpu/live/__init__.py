@@ -1,0 +1,11 @@
+"""Live contributivity tier: resident incremental games, sub-second
+Shapley queries from recorded-round reconstruction, and DPVS-style
+dynamic coalition pruning. See live/game.py for the full contract."""
+
+from .dpvs import PrunedReconstruction, info_scores, low_information
+from .game import (LIVE_METHODS, LiveGame, LiveGameFull, LiveQueryResult,
+                   MAX_EXACT_PARTNERS)
+
+__all__ = ["LIVE_METHODS", "LiveGame", "LiveGameFull", "LiveQueryResult",
+           "MAX_EXACT_PARTNERS", "PrunedReconstruction", "info_scores",
+           "low_information"]

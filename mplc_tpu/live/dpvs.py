@@ -1,0 +1,114 @@
+"""DPVS-style dynamic coalition pruning for the live contributivity tier.
+
+DPVS-Shapley (arXiv:2410.15093) accelerates federated contribution
+evaluation by dynamically pruning low-contribution participants from the
+coalition-evaluation schedule: a participant whose recorded updates carry
+little information cannot move v(S) measurably, so coalitions that differ
+only by such participants need not be evaluated separately. This module
+implements that idea against the live tier's resident round history:
+
+  - **Information scores.** Each partner p gets
+    `s_p = sum_r |w_h[r, p]| * ||delta_p^r||_2` over the game's recorded
+    aggregation rounds — the total weighted parameter motion the partner
+    contributed to the grand-coalition trajectory. Zero-weight rounds
+    contribute zero; a dropped partner's exactly-zero deltas score 0.
+  - **Pruning rule.** With threshold tau in (0, 1], partners with
+    `s_p < tau * max_q s_q` are LOW-INFORMATION. A requested coalition S
+    is *projected* onto the high-information partners
+    (`proj(S) = S minus the low set`); all coalitions sharing a
+    projection are served the projection's reconstructed value from ONE
+    device evaluation. Pruned partners therefore carry exactly-zero
+    marginals everywhere — the DPVS approximation, which is tight
+    precisely when the information scores are small.
+  - **Exactness-preserving off switch.** tau = 0 (the
+    `MPLC_TPU_LIVE_PRUNE_TAU` default) disables pruning entirely: the
+    query path never constructs a `PrunedReconstruction` and values are
+    bit-identical to the unpruned reconstruction path (equality-tested in
+    tests/test_live.py).
+
+Documented deviation from the paper: DPVS prunes during live federated
+training rounds using per-round validation signals; here the pruning
+signal is derived *post hoc* from the recorded update stream (the only
+signal a retrain-free reconstruction game has), and pruning is a
+coalition-selection policy over reconstruction evals, not a training-time
+participant filter. See doc/documentation.md "Live contributivity tier".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+
+
+def info_scores(rounds, partners_count: int) -> np.ndarray:
+    """Per-partner information score over `rounds`, a list of
+    `(deltas, weights)` pairs with host-array leaves of shape
+    `[P, ...]` / `[P]`: `s_p = sum_r |w[r, p]| * ||delta_p^r||_2` (the
+    L2 norm taken over all parameter leaves of round r's partner-p
+    delta)."""
+    import jax
+
+    s = np.zeros(partners_count, float)
+    for deltas, weights in rounds:
+        sq = np.zeros(partners_count, float)
+        for leaf in jax.tree_util.tree_leaves(deltas):
+            flat = np.asarray(leaf, float).reshape(partners_count, -1)
+            sq += np.sum(flat * flat, axis=1)
+        s += np.abs(np.asarray(weights, float)) * np.sqrt(sq)
+    return s
+
+
+def low_information(scores: np.ndarray, tau: float) -> frozenset:
+    """The pruned-partner set for threshold `tau`: partners whose score
+    falls below `tau * max(scores)`. The max-scoring partner can never be
+    pruned (strict inequality), and a degenerate all-zero score vector
+    prunes nobody — pruning must never silently empty the game."""
+    if tau <= 0 or scores.size == 0:
+        return frozenset()
+    mx = float(scores.max())
+    if mx <= 0:
+        return frozenset()
+    return frozenset(int(i) for i in np.nonzero(scores < tau * mx)[0])
+
+
+class PrunedReconstruction:
+    """A coalition-selection policy wrapped around a
+    `ReconstructionEvaluator`: requested coalitions are projected onto
+    the high-information partners and served from the projection's
+    evaluated value. Mirrors the evaluator's estimator-facing surface
+    (`evaluate` + a `values` memo the permutation sweeps read), so every
+    live query method runs against it unchanged."""
+
+    def __init__(self, recon, low: frozenset):
+        self.recon = recon
+        self.low = low
+        self.values: dict[tuple, float] = {(): 0.0}
+        # coalitions served from a projected representative instead of
+        # their own device evaluation (the DPVS saving, counter-asserted)
+        self.pruned = 0
+
+    @property
+    def reconstructions(self) -> int:
+        return self.recon.reconstructions
+
+    def _project(self, key: tuple) -> tuple:
+        return tuple(i for i in key if i not in self.low)
+
+    def evaluate(self, subsets) -> np.ndarray:
+        keys = [tuple(sorted(int(i) for i in s)) for s in subsets]
+        unique = [k for k in dict.fromkeys(keys) if k not in self.values]
+        proj = {k: self._project(k) for k in unique}
+        need = [p for p in dict.fromkeys(proj.values()) if p]
+        if need:
+            self.recon.evaluate(need)
+        pruned = 0
+        for k in unique:
+            p = proj[k]
+            if k != p:
+                pruned += 1
+            self.values[k] = self.recon.values[p] if p else 0.0
+        if pruned:
+            self.pruned += pruned
+            obs_metrics.counter("live.pruned_coalitions").inc(pruned)
+        return np.array([self.values[k] for k in keys])

@@ -1,0 +1,539 @@
+"""The live contributivity tier: resident incremental games.
+
+Every estimator before this package is batch-shaped — a contributivity
+query means "submit a job, run a sweep". A `LiveGame` inverts that: the
+tenant's recorded per-partner update history (the `upd_h`/`w_h` stream of
+contrib/reconstruct.py) stays RESIDENT, new aggregation rounds are
+appended as they happen, and `query(method=...)` answers "what is my
+Shapley value *now*" by GTG-style reconstruction against pre-banked AOT
+executables — sub-second on the warm path, zero training batches ever
+(asserted via the `engine.partner_passes` / `engine.batch` counters in
+tests/test_live.py).
+
+Incremental semantics — the round-stamp invalidation rule:
+
+  - `append_round(deltas, weights)` appends one aggregation round
+    (per-partner parameter deltas `[P, ...]` + normalized weights `[P]`)
+    to the resident history. A round with any non-zero weight is
+    INVALIDATING: it advances the game's `round_stamp`, and every
+    reconstruction-derived value (the evaluator's memo, cached query
+    results) carries the stamp it was computed at and is lazily
+    recomputed on the next query. A round whose weights are ALL zero is
+    a pass-through for the reconstruction scan (the zero-denominator
+    rule in contrib/reconstruct.py) and is NON-invalidating: it is
+    journaled and counted resident, but memoized values survive it
+    bit-identically — which is what makes repeated queries O(memo)
+    regardless of how much history has accumulated.
+  - The engine's EXACT memo (`charac_fct_values`, retrained values) is
+    never touched by appends: retrained v(S) does not depend on the
+    recorded stream, only reconstruction-derived values do.
+
+Durability: with a `journal_path` the game rides the sweep service's
+checksummed WAL (service/journal.py — same torn-tail quarantine, same
+fsync-before-return contract): one `live_init` record (partners/model
+guard + the replay-origin init params) and one `live_round` record per
+append. A kill→restart restores the game bit-identically — floats
+round-trip exactly through the JSON encoding, so a restored game's
+queries equal the killed game's (equality-tested).
+
+Execution: queries run through `ReconstructionEvaluator` — the same
+merged slot buckets, device-batch caps, fault ladder and span/event
+vocabulary as every other reconstruction — with the program bank
+extended to AOT-compile the fused reconstruct+eval program per
+(rounds, width) under shared-scope keys, so a second tenant of the same
+shape (or the same game after a restart) executes from the bank with
+zero compiles. DPVS-style pruning (live/dpvs.py,
+`MPLC_TPU_LIVE_PRUNE_TAU`) optionally collapses coalitions that differ
+only by low-information partners onto one evaluated representative;
+tau = 0 (default) is the exactness-preserving off switch.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import numpy as np
+
+import jax
+
+from .. import constants
+from ..contrib.reconstruct import RecordedRun, _check_not_2d
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..service.journal import SweepJournal
+from .dpvs import PrunedReconstruction, info_scores, low_information
+
+logger = logging.getLogger("mplc_tpu")
+
+#: Methods `LiveGame.query` answers ("Shapley values" aliases "exact").
+LIVE_METHODS = ("exact", "GTG-Shapley", "SVARM")
+
+# exact queries materialize the 2^P host-side table (shapley weights over
+# every bitmask) — past this partner count the host cost alone breaks the
+# sub-second contract; the sampling methods have no such bound
+MAX_EXACT_PARTNERS = 16
+
+
+class LiveGameFull(RuntimeError):
+    """append_round past the resident-round cap
+    (`MPLC_TPU_LIVE_MAX_ROUNDS`): the game refuses to grow its
+    reconstruction depth and journal without bound. Start a new game (or
+    raise the cap) — silently evicting history would change v(S)."""
+
+
+class LiveQueryResult:
+    """One answered live query: the scores, the round-stamp they were
+    computed at (`stamp` — a result whose stamp trails the game's
+    `round_stamp` is stale and is never served), and the query's cost
+    accounting."""
+
+    __slots__ = ("method", "scores", "stamp", "rounds", "seconds",
+                 "evaluations", "pruned_coalitions", "prune_tau",
+                 "low_info", "trust")
+
+    def __init__(self, method, scores, stamp, rounds, seconds, evaluations,
+                 pruned_coalitions, prune_tau, low_info, trust):
+        self.method = method
+        self.scores = np.asarray(scores)
+        self.stamp = int(stamp)
+        self.rounds = int(rounds)
+        self.seconds = float(seconds)
+        self.evaluations = int(evaluations)
+        self.pruned_coalitions = int(pruned_coalitions)
+        self.prune_tau = float(prune_tau)
+        self.low_info = tuple(low_info)
+        self.trust = trust
+
+    def describe(self) -> dict:
+        return {"method": self.method, "stamp": self.stamp,
+                "rounds": self.rounds, "seconds": round(self.seconds, 6),
+                "evaluations": self.evaluations,
+                "pruned_coalitions": self.pruned_coalitions,
+                "prune_tau": self.prune_tau,
+                "scores": [float(x) for x in self.scores]}
+
+
+def _encode_tree(tree) -> list:
+    """JSON-encode a pytree's leaves as [[shape, dtype, flat-values]...]
+    (floats round-trip exactly through json's repr-based serialization —
+    the same property the service WAL's v(S) records rest on)."""
+    out = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = np.asarray(leaf)
+        out.append([list(a.shape), str(a.dtype), a.ravel().tolist()])
+    return out
+
+
+def _decode_tree(doc: list, treedef):
+    leaves = [np.asarray(vals, dtype=np.dtype(dt)).reshape([int(d) for d in shape])
+              for shape, dt, vals in doc]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class LiveGame:
+    """One tenant's resident incremental contributivity game."""
+
+    def __init__(self, scenario, tenant: str = "tenant0",
+                 journal_path=None, max_rounds: "int | None" = None,
+                 engine=None):
+        if engine is None:
+            engine = getattr(scenario, "_charac_engine", None)
+        if engine is None:
+            from ..contrib.bank import ProgramBank, bank_enabled
+            from ..contrib.engine import CharacteristicEngine
+            engine = CharacteristicEngine(scenario)
+            if bank_enabled():
+                # shared-scope program keys (the sweep service's mode):
+                # a second tenant of the same SHAPE — or this game after
+                # a restart — is served the same banked executables
+                engine.program_bank = ProgramBank(engine, shared=True)
+            scenario._charac_engine = engine
+        elif getattr(scenario, "_charac_engine", None) is None:
+            scenario._charac_engine = engine
+        _check_not_2d(engine)
+        self.engine = engine
+        self.scenario = scenario
+        self.tenant = str(tenant)
+        self.max_rounds = (int(max_rounds) if max_rounds is not None
+                           else constants._env_positive_int(
+                               constants.LIVE_MAX_ROUNDS_ENV, 4096))
+        # the replay origin: reconstruction replays rounds from exactly
+        # these params. Derived from the engine's grand-coalition rng —
+        # the same stream record_updates initializes from — unless a
+        # journal restore below supplies the recorded origin.
+        self._init_params = self._derive_init_params()
+        self._treedef = jax.tree_util.tree_structure(self._init_params)
+        # resident history: [(deltas pytree of np [P, ...], weights np [P])]
+        self._rounds: list = []
+        # advanced by every INVALIDATING append; reconstruction-derived
+        # values carry the stamp they were computed at
+        self.round_stamp = 0
+        self.queries = 0
+        self._recon = None
+        self._recon_stamp = -1
+        self._results: dict = {}
+        self._info_cache = None  # (stamp, rounds_resident) -> scores
+        # one game = one serialized surface: the service's worker POOL
+        # can land two live-query quanta (or an append racing a query)
+        # for the same tenant on different workers, and the evaluator /
+        # memo / stamp trio must move atomically
+        self._lock = threading.RLock()
+
+        self._journal = None
+        if journal_path is not None:
+            records, _torn = SweepJournal.replay(journal_path)
+            restored = self._restore(records)
+            self._journal = SweepJournal(journal_path)
+            if not restored:
+                self._journal.append({
+                    "type": "live_init", "tenant": self.tenant,
+                    "partners_count": int(engine.partners_count),
+                    "model": getattr(engine.model, "name", "?"),
+                    "params": _encode_tree(self._init_params)})
+        self._set_gauges()
+
+    # -- construction helpers -------------------------------------------
+
+    def _derive_init_params(self):
+        eng = self.engine
+        full = tuple(range(eng.partners_count))
+        eff = eng._effective_subset(full)
+        rng = eng._coalition_rng(eff if eff else full)
+        trainer = eng.multi_pipe.trainer
+        params = trainer.init_state(rng, eng.partners_count).params
+        return jax.tree_util.tree_map(
+            lambda l: np.asarray(jax.device_get(l)), params)
+
+    @classmethod
+    def from_recording(cls, scenario, **kw) -> "LiveGame":
+        """Seed a live game from ONE grand-coalition recording run
+        (contrib/reconstruct.record_updates): the recorded rounds become
+        the game's initial resident history, after which `append_round`
+        extends it incrementally. The recording is the only training the
+        game ever pays."""
+        game = cls(scenario, **kw)
+        if game.rounds_resident:
+            # a journal restore already holds history: re-recording would
+            # double every round
+            return game
+        from ..contrib.reconstruct import record_updates
+        rec = record_updates(game.engine)
+        deltas = jax.tree_util.tree_map(
+            lambda l: np.asarray(jax.device_get(l)), rec.deltas)
+        weights = np.asarray(jax.device_get(rec.weights))
+        with game._lock:
+            # one durability point for the whole recording: a realistic
+            # run records epochs x minibatches rounds, and seeding must
+            # not pay one journal fsync per round
+            game._append_rounds([
+                (jax.tree_util.tree_map(lambda l, _r=r: l[_r], deltas),
+                 weights[r])
+                for r in range(rec.rounds)])
+        return game
+
+    def _restore(self, records) -> bool:
+        """Replay a journal's live records into this game. Returns True
+        when a `live_init` record was found (the journal already owns the
+        game's identity)."""
+        inited = False
+        rounds = 0
+        for rec in records:
+            kind = rec.get("type")
+            if kind == "live_init":
+                jp = rec.get("partners_count")
+                if jp is not None and int(jp) != self.engine.partners_count:
+                    raise ValueError(
+                        f"live journal was recorded for {jp} partners but "
+                        f"this game has {self.engine.partners_count} — "
+                        "refusing to restore a different game's history")
+                jm = rec.get("model")
+                ours = getattr(self.engine.model, "name", "?")
+                if jm is not None and jm != ours:
+                    raise ValueError(
+                        f"live journal was recorded for model {jm!r} but "
+                        f"this game trains {ours!r} — refusing to restore "
+                        "a different game's history (same-shape "
+                        "architectures would silently answer the wrong "
+                        "game)")
+                self._init_params = _decode_tree(rec["params"], self._treedef)
+                inited = True
+            elif kind == "live_round":
+                deltas = _decode_tree(rec["deltas"], self._treedef)
+                weights = np.asarray(rec["weights"], np.float32)
+                self._rounds.append((deltas, weights))
+                if np.any(weights != 0):
+                    self.round_stamp += 1
+                rounds += 1
+        if rounds:
+            obs_metrics.counter("live.games_recovered").inc()
+            obs_trace.event("live.recover", tenant=self.tenant,
+                            rounds=rounds, stamp=self.round_stamp)
+        return inited
+
+    # -- the incremental surface ----------------------------------------
+
+    @property
+    def rounds_resident(self) -> int:
+        return len(self._rounds)
+
+    def round_history(self) -> list:
+        """The resident `(deltas, weights)` rounds, in append order
+        (host arrays; the bench's append-replay loop reads this)."""
+        return list(self._rounds)
+
+    def _set_gauges(self) -> None:
+        obs_metrics.gauge("live.rounds_resident",
+                          tenant=self.tenant).set(len(self._rounds))
+
+    def append_round(self, deltas, weights) -> int:
+        """Append one aggregation round's per-partner deltas (`[P, ...]`
+        pytree, same structure as the model params) and normalized
+        weights (`[P]`). Returns the game's round-stamp after the append
+        — unchanged for a non-invalidating (all-zero-weight) round, so
+        memoized values provably survive it. The round is journaled
+        (durably, fsync'd) before any in-memory state changes."""
+        with self._lock:
+            return self._append_rounds([(deltas, weights)])
+
+    def _normalize_round(self, deltas, weights):
+        """Validate one round's shapes and pull it to host arrays."""
+        P = self.engine.partners_count
+        w = np.asarray(jax.device_get(weights), np.float32).reshape(P)
+        d = jax.tree_util.tree_map(
+            lambda l: np.asarray(jax.device_get(l)), deltas)
+        if jax.tree_util.tree_structure(d) != self._treedef:
+            raise ValueError(
+                "append_round deltas pytree does not match the model's "
+                "parameter structure")
+        for leaf, ref in zip(jax.tree_util.tree_leaves(d),
+                             jax.tree_util.tree_leaves(self._init_params)):
+            if leaf.shape != (P,) + ref.shape:
+                raise ValueError(
+                    f"append_round delta leaf has shape {leaf.shape}, "
+                    f"expected {(P,) + ref.shape} (a [partners, ...] stack "
+                    "of per-partner parameter deltas)")
+        return d, w
+
+    def _append_rounds(self, rounds) -> int:
+        """Append a batch of rounds with ONE journal durability point
+        (`append_many` — from_recording seeds epochs x minibatches rounds
+        and must not pay one fsync per round). Caller holds the lock."""
+        if len(self._rounds) + len(rounds) > self.max_rounds:
+            raise LiveGameFull(
+                f"live game for tenant {self.tenant!r} holds "
+                f"{len(self._rounds)} resident rounds and was asked for "
+                f"{len(rounds)} more — the {constants.LIVE_MAX_ROUNDS_ENV} "
+                f"cap ({self.max_rounds}); evicting history would change "
+                "v(S), so start a new game or raise the cap")
+        normalized = [self._normalize_round(d, w) for d, w in rounds]
+        if self._journal is not None:
+            self._journal.append_many([
+                {"type": "live_round", "tenant": self.tenant,
+                 "seq": len(self._rounds) + 1 + i,
+                 "weights": [float(x) for x in w],
+                 "deltas": _encode_tree(d)}
+                for i, (d, w) in enumerate(normalized)])
+        for d, w in normalized:
+            self._rounds.append((d, w))
+            invalidating = bool(np.any(w != 0))
+            if invalidating:
+                self.round_stamp += 1
+            obs_metrics.counter("live.rounds_appended").inc()
+            obs_trace.event("live.append", tenant=self.tenant,
+                            seq=len(self._rounds), stamp=self.round_stamp,
+                            invalidating=invalidating)
+        self._set_gauges()
+        return self.round_stamp
+
+    # -- reconstruction plumbing ----------------------------------------
+
+    def _build_recorded(self) -> RecordedRun:
+        """The resident history as a `RecordedRun`: zero-weight rounds
+        are excluded from the stack (the scan would pass through them
+        unchanged), so a restored game and the live game that skipped
+        them reconstruct bit-identically."""
+        import jax.numpy as jnp
+        P = self.engine.partners_count
+        live = [(d, w) for d, w in self._rounds if np.any(w != 0)]
+        if live:
+            deltas = jax.tree_util.tree_map(
+                lambda *leaves: jnp.asarray(np.stack(leaves)),
+                *[d for d, _ in live])
+            weights = jnp.asarray(np.stack([w for _, w in live]))
+        else:
+            deltas = jax.tree_util.tree_map(
+                lambda l: jnp.zeros((0, P) + l.shape, l.dtype),
+                self._init_params)
+            weights = jnp.zeros((0, P), np.float32)
+        init = jax.tree_util.tree_map(jnp.asarray, self._init_params)
+        mem = int(sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                      for l in jax.tree_util.tree_leaves(deltas))
+                  + weights.size * weights.dtype.itemsize)
+        return RecordedRun(init_params=init, deltas=deltas, weights=weights,
+                           rounds=len(live), partners_count=P,
+                           epochs_done=0, training_passes=0,
+                           memory_bytes=mem)
+
+    def _evaluator(self):
+        """The game's (round-stamped) reconstruction evaluator. Stale
+        stamps swap the recorded stream in place — the memo is derived
+        from the old stream and dropped, while the evaluator's jitted
+        program cache (and the AOT bank) survives."""
+        from ..contrib.reconstruct import ReconstructionEvaluator
+        if self._recon is None:
+            self._recon = ReconstructionEvaluator(
+                self.engine, recorded=self._build_recorded())
+            self._recon.use_bank = True
+            self._recon_stamp = self.round_stamp
+        elif self._recon_stamp != self.round_stamp:
+            self._recon.reset_recorded(self._build_recorded())
+            self._recon_stamp = self.round_stamp
+        return self._recon
+
+    def _info_scores(self) -> np.ndarray:
+        key = (self.round_stamp, len(self._rounds))
+        if self._info_cache is None or self._info_cache[0] != key:
+            self._info_cache = (key, info_scores(
+                self._rounds, self.engine.partners_count))
+        return self._info_cache[1]
+
+    # -- queries ---------------------------------------------------------
+
+    def query(self, method: str = "GTG-Shapley", prune: "float | None" = None,
+              **method_kw) -> LiveQueryResult:
+        """Answer a contributivity query from the resident game.
+
+        `method`: "exact" (full reconstructed powerset + exact Shapley;
+        partner counts <= 16), "GTG-Shapley" or "SVARM" (their usual
+        kwargs pass through). `prune` is the DPVS threshold tau (None =
+        the `MPLC_TPU_LIVE_PRUNE_TAU` env default, 0 = off). Results are
+        memoized per (method, tau, kwargs) and served without any device
+        work while the round-stamp is unchanged; a stale result is never
+        served. Queries (and appends) on one game are serialized by the
+        game's lock — the service's worker pool can schedule two of a
+        tenant's quanta concurrently."""
+        with self._lock:
+            return self._query_locked(method, prune, method_kw)
+
+    def _query_locked(self, method: str, prune: "float | None",
+                      method_kw: dict) -> LiveQueryResult:
+        if method == "Shapley values":
+            method = "exact"
+        if method not in LIVE_METHODS:
+            raise ValueError(
+                f"unknown live query method {method!r} (expected one of "
+                f"{LIVE_METHODS})")
+        # tau lives in [0, 1]: past 1 even the max-scoring partner would
+        # prune and every query would silently return all-zero scores.
+        # An explicit argument fails fast; the env knob degrades with a
+        # warning (the same typo'd-knob contract as every other knob)
+        if prune is None:
+            tau = constants._env_nonneg_float(
+                constants.LIVE_PRUNE_TAU_ENV, 0.0)
+            if tau > 1.0:
+                import warnings
+                warnings.warn(
+                    f"{constants.LIVE_PRUNE_TAU_ENV}={tau} is outside "
+                    "[0, 1]; pruning disabled for this query",
+                    stacklevel=3)
+                tau = 0.0
+        else:
+            tau = float(prune)
+            if not 0.0 <= tau <= 1.0:
+                raise ValueError(
+                    f"prune tau must be in [0, 1], got {tau}")
+        n = self.engine.partners_count
+        key = (method, tau, tuple(sorted(method_kw.items())))
+        span = obs_trace.start_span(
+            "live.query", tenant=self.tenant, method=method,
+            rounds=self.rounds_resident, stamp=self.round_stamp,
+            prune_tau=tau)
+        try:
+            cached = self._results.get(key)
+            if cached is not None and cached.stamp == self.round_stamp:
+                obs_metrics.counter("live.queries").inc()
+                obs_metrics.counter("live.query_memo_hits").inc()
+                span.attrs.update(memo_hit=True, evaluations=0, pruned=0)
+                span.end()
+                obs_metrics.histogram(
+                    "live.query_sec",
+                    tenant=self.tenant).observe(span.duration)
+                return cached
+            recon = self._evaluator()
+            before = recon.reconstructions
+            low: frozenset = frozenset()
+            ev = recon
+            if tau > 0:
+                low = low_information(self._info_scores(), tau)
+                if low:
+                    ev = PrunedReconstruction(recon, low)
+            trust = None
+            t0 = time.perf_counter()
+            if method == "exact":
+                if n > MAX_EXACT_PARTNERS:
+                    raise ValueError(
+                        f"live exact queries are limited to "
+                        f"{MAX_EXACT_PARTNERS} partners (the 2^P host "
+                        f"table; this game has {n}) — use GTG-Shapley or "
+                        "SVARM")
+                from ..contrib.shapley import (powerset_order,
+                                               shapley_from_characteristic)
+                ev.evaluate(powerset_order(n))
+                scores = np.asarray(
+                    shapley_from_characteristic(n, ev.values))
+            else:
+                from ..contrib.contributivity import Contributivity
+                eng = self.engine
+                prev = getattr(eng, "_reconstruction", None)
+                eng._reconstruction = ev
+                try:
+                    c = Contributivity(self.scenario)
+                    if method == "GTG-Shapley":
+                        c.GTG_Shapley(**method_kw)
+                    else:
+                        c.SVARM(**method_kw)
+                finally:
+                    eng._reconstruction = prev
+                scores = np.asarray(c.contributivity_scores)
+                trust = c.trust
+            seconds = time.perf_counter() - t0
+            evals = recon.reconstructions - before
+            pruned = ev.pruned if isinstance(ev, PrunedReconstruction) else 0
+            result = LiveQueryResult(
+                method=method, scores=scores, stamp=self.round_stamp,
+                rounds=self.rounds_resident, seconds=seconds,
+                evaluations=evals, pruned_coalitions=pruned, prune_tau=tau,
+                low_info=sorted(low), trust=trust)
+            self._results[key] = result
+            self.queries += 1
+            obs_metrics.counter("live.queries").inc()
+            obs_metrics.counter("live.coalition_evaluations").inc(evals)
+            span.attrs.update(memo_hit=False, evaluations=evals,
+                              pruned=pruned, low_info=len(low))
+            span.end()
+            obs_metrics.histogram(
+                "live.query_sec", tenant=self.tenant).observe(span.duration)
+            return result
+        except BaseException:
+            span.cancel()
+            raise
+
+    # -- observability / lifecycle --------------------------------------
+
+    def describe(self) -> dict:
+        """The game's /varz row (JSON-serializable)."""
+        return {
+            "tenant": self.tenant,
+            "rounds_resident": self.rounds_resident,
+            "round_stamp": self.round_stamp,
+            "queries": self.queries,
+            "results_cached": len(self._results),
+            "max_rounds": self.max_rounds,
+            "journal": self._journal.path if self._journal else None,
+        }
+
+    def close(self) -> None:
+        if self._journal is not None:
+            self._journal.close()

@@ -247,7 +247,11 @@ def redact_varz(doc, viewer: "str | None" = None,
         `name{tenant=...}` convention) are rewritten with the hashed tag
         unless the label names the viewer;
       - `tenant_device_seconds`-style per-tenant maps: non-viewer keys
-        are hashed (values kept — aggregate billing is not an identity).
+        are hashed (values kept — aggregate billing is not an identity);
+      - the live tier's `live_games` block (tenant-keyed game rows):
+        non-viewer rows collapse to a hashed-tenant tag plus the
+        activity scalars, with the journal PATH dropped — a filesystem
+        path is operator detail, not a co-tenant's business.
 
     `key` (the master token) makes the hashed tags HMAC-keyed — see
     `_tenant_tag`."""
@@ -284,6 +288,20 @@ def redact_varz(doc, viewer: "str | None" = None,
                     out[k] = {(t if t == viewer
                                else _tenant_tag(t, key)): v
                               for t, v in val.items()}
+                elif (k == "live_games" and isinstance(val, dict)
+                      and any(isinstance(r, dict) and "tenant" in r
+                              for r in val.values())):
+                    out[k] = {
+                        (t if t == viewer else _tenant_tag(t, key)):
+                        (dict(row) if t == viewer
+                         else {"tenant": _tenant_tag(row.get("tenant"),
+                                                     key),
+                               "rounds_resident":
+                                   row.get("rounds_resident"),
+                               "round_stamp": row.get("round_stamp"),
+                               "queries": row.get("queries"),
+                               "redacted": True})
+                        for t, row in val.items()}
                 elif isinstance(k, str) and "tenant=" in k:
                     out[_redact_key(k)] = walk(val)
                 else:

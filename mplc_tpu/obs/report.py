@@ -46,6 +46,12 @@ quantities every perf PR needs as a measured before/after:
     (completed/quarantined/cancelled/recovered), the cross-tenant
     packed-batch count, and per-tenant fair-share cost attribution from
     the `service.slice` spans' batch accounting;
+  - a live row (live-contributivity-tier runs): query counts and memo
+    hits, reconstruction evaluations and DPVS-pruned coalitions, rounds
+    appended/resident and journal-restored games, fresh-query latency
+    quantiles and per-method counts from the `live.query` events —
+    mirroring the `live.query_sec` histogram and per-tenant
+    rounds-resident gauge the /metrics endpoint exports;
   - an slo row (service runs): per-tenant latency quantiles — queue wait
     (submit -> first quantum) and time-to-first-value from the terminal
     `service.job` events, slice-duration p50/p95/p99 from the
@@ -122,6 +128,8 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     svc_job_faults: dict = {}   # tenant -> failed-attempt count
     trust = None
     per_method: dict = {}
+    live_queries: list = []         # (dur, attrs) of live.query events
+    live_appends = live_recovers = 0
     recon_batches = recon_coalitions = 0
     recon_s = 0.0
     recorded = None
@@ -304,6 +312,12 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             # service.job_retries counter this row mirrors
             tn = a.get("tenant", "?")
             svc_job_faults[tn] = svc_job_faults.get(tn, 0) + 1
+        elif name == "live.query":
+            live_queries.append((dur, a))
+        elif name == "live.append":
+            live_appends += 1
+        elif name == "live.recover":
+            live_recovers += 1
         elif name == "contrib.trust":
             # one trust row per sweep; the last event wins (a re-run of
             # the estimator within one collected region supersedes)
@@ -554,6 +568,36 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
         report["roofline"] = {"peak_flops": peak_flops,
                               "hbm_peak_bytes_per_s": hbm_bytes_per_s,
                               "programs": rows}
+    if live_queries or live_appends or live_recovers:
+        # the live contributivity tier's view: fresh-query latency (memo
+        # hits kept separate — they answer in microseconds and would
+        # flatter the quantiles), evaluation/pruning totals, and the
+        # resident-round level the latest query saw
+        fresh = sorted(d for d, a in live_queries if not a.get("memo_hit"))
+        per_m: dict = {}
+        for _d, a in live_queries:
+            m = a.get("method", "?")
+            per_m[m] = per_m.get(m, 0) + 1
+        report["live"] = {
+            "queries": len(live_queries),
+            "memo_hits": sum(1 for _d, a in live_queries
+                             if a.get("memo_hit")),
+            "evaluations": sum(int(a.get("evaluations") or 0)
+                               for _d, a in live_queries),
+            "pruned_coalitions": sum(int(a.get("pruned") or 0)
+                                     for _d, a in live_queries),
+            "rounds_appended": live_appends,
+            "recovered_games": live_recovers,
+            "rounds_resident": (int(live_queries[-1][1].get("rounds", 0))
+                                if live_queries else None),
+            "per_method": per_m,
+            "query_s": {
+                "count": len(fresh),
+                "p50": _pctl(fresh, 0.50),
+                "p95": _pctl(fresh, 0.95),
+                "max": fresh[-1] if fresh else None,
+            },
+        }
     if svc_tenants or svc_jobs:
         # the multi-tenant service view: job outcomes, the cross-tenant
         # program-packing win, and fair-share cost attribution — each
@@ -752,6 +796,21 @@ def format_report(report: dict) -> str:
                 f"{_q(sl, 'p99')}s  "
                 f"deadline_misses={s['deadline_misses']}  "
                 f"retries={s['retries']}")
+    lv = report.get("live")
+    if lv is not None:
+        q = lv.get("query_s") or {}
+
+        def _s(v):
+            return f"{v:.3f}s" if v is not None else "n/a"
+        lines.append(
+            f"  live        queries={lv['queries']}  "
+            f"memo_hits={lv['memo_hits']}  "
+            f"evaluations={lv['evaluations']}  "
+            f"pruned={lv['pruned_coalitions']}  "
+            f"rounds={lv.get('rounds_resident') if lv.get('rounds_resident') is not None else '?'}"
+            + (f"  recovered={lv['recovered_games']}"
+               if lv.get("recovered_games") else "")
+            + f"  query p50/p95={_s(q.get('p50'))}/{_s(q.get('p95'))}")
     rc = report.get("reconstruction")
     if rc is not None:
         mem = rc.get("recorded_update_bytes")

@@ -109,6 +109,12 @@ SPAN_REGISTRY = {
                    "ttfv_sec/deadline_missed)",
     "service.job_fault": "one failed job attempt (pre retry/quarantine)",
     "service.recover": "journal-seeded job recovery",
+    "live.query": "one live contributivity query (attrs: tenant/method/"
+                  "rounds/stamp/prune_tau/memo_hit/evaluations/pruned)",
+    "live.append": "one aggregation round appended to a resident live "
+                   "game (attrs: tenant/seq/stamp/invalidating)",
+    "live.recover": "journal-restored live game (attrs: tenant/rounds/"
+                    "stamp)",
     "service.journal_broken": "WAL append failure (journaling disabled)",
     "flight.dump": "flight-recorder postmortem written (attrs: reason/"
                    "path)",

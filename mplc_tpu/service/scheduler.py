@@ -88,6 +88,20 @@ The headline is the fault model, not the queue:
   `MPLC_TPU_PROFILE_DIR/<job_id>` (best-effort; path on the terminal
   event).
 
+  **The live contributivity tier.** `live_game(scenario, tenant=...)`
+  registers a tenant's RESIDENT incremental game (mplc_tpu/live/:
+  recorded rounds stay in-process, journaled, round-stamp invalidated),
+  `append_round(tenant, deltas, weights)` feeds it, and
+  `submit_live(tenant, method=..., prune=...)` runs "what is my Shapley
+  value now" as a LOW-LATENCY job class on this same machinery —
+  admission bound, tier-weighted quanta (default one tier above the
+  batch default), overload shedding, deadlines
+  (`MPLC_TPU_LIVE_QUERY_DEADLINE_SEC` default), journaled terminals —
+  answered from reconstruction against banked programs with zero
+  training batches. The resident game's engine is shared across queries
+  and never released at job completion; per-tenant games appear on
+  /varz (`live_games`) and the `live.rounds_resident` gauge.
+
 Live telemetry: when `MPLC_TPU_METRICS_PORT` is set, constructing a
 service starts the obs/export.py HTTP plane — /metrics (Prometheus,
 incl. the per-tenant SLO histograms instrumented here: queue wait,
@@ -235,6 +249,17 @@ class SweepJob:
         self.status = "queued"
         self.engine = None
         self.subsets = None
+        # live-query jobs (submit_live): {"game", "method", "prune", "kw"}
+        # — the quantum answers from the tenant's RESIDENT LiveGame
+        # instead of building a private sweep engine. `_live_billed`
+        # carries the quantum's game-lock-scoped (device_sec, basis)
+        # delta to the slice span / failure-billing paths (the generic
+        # pre-quantum meter snapshot is skipped: the shared meter may be
+        # mid-sibling-quantum at snapshot time)
+        self._live_query: "dict | None" = None
+        self._live_billed: "tuple | None" = None
+        self._live_counts: "dict | None" = None
+        self.live_result = None
         self.attempts = 0
         self.recovered_values = 0
         self.packed_batches = 0
@@ -455,6 +480,13 @@ class SweepService:
                 constants.SERVICE_SHED_P99_ENV, 0.0))
         self._max_job_retries = constants._env_positive_int(
             constants.MAX_RETRIES_ENV, 3)
+        # the live contributivity tier (mplc_tpu/live/): per-tenant
+        # RESIDENT games + the default deadline for the low-latency
+        # live-query job class
+        self._live_games: dict = {}
+        self._live_create_lock = threading.Lock()
+        self._live_deadline = constants._env_nonneg_float(
+            constants.LIVE_QUERY_DEADLINE_ENV, 0.0)
         self._heartbeat = time.monotonic()
         # live telemetry plane: the /metrics//healthz//varz endpoints
         # exist ONLY when MPLC_TPU_METRICS_PORT is set (no thread, no
@@ -692,6 +724,12 @@ class SweepService:
                 "admission": self._admission.view(),
                 "closed": self._closed,
                 "recovered_jobs": len(self._recovered),
+                # the live tier's per-tenant resident games: rounds
+                # resident, round-stamp, query counts (the dashboard's
+                # rounds-resident gauge mirrors live.rounds_resident
+                # on /metrics)
+                "live_games": {t: g.describe()
+                               for t, g in sorted(self._live_games.items())},
                 # lifetime metered device-seconds per tenant (restored
                 # from the journal on restart — the billing meter)
                 "tenant_device_seconds": {
@@ -720,7 +758,8 @@ class SweepService:
                deadline_sec: "float | None" = None,
                job_id: "str | None" = None,
                priority: "int | None" = None,
-               profile: bool = False) -> SweepJob:
+               profile: bool = False,
+               _live: "dict | None" = None) -> SweepJob:
         """Accept a Scenario+method job onto the bounded queue.
 
         `priority` is the job's integer tier (default
@@ -739,7 +778,13 @@ class SweepService:
         its `retry_after_sec` is the live queue-wait p50 backoff hint),
         `ServiceRejected` on a fault-plan injected admission reject. The
         accepted submission is journaled before this returns."""
-        if method not in constants.CONTRIBUTIVITY_METHODS:
+        if _live is not None:
+            from ..live import LIVE_METHODS
+            if _live["method"] not in LIVE_METHODS:
+                raise ValueError(
+                    f"unknown live query method {_live['method']!r} "
+                    f"(expected one of {LIVE_METHODS})")
+        elif method not in constants.CONTRIBUTIVITY_METHODS:
             # validated synchronously: the dispatcher would only log a
             # warning for an unknown name, and a job that "completes"
             # with no scores is worse than a clean submit-time error
@@ -788,10 +833,13 @@ class SweepService:
             if job_id in self._jobs:
                 raise ValueError(f"job id {job_id!r} already submitted "
                                  "to this service")
-            job = SweepJob(self, job_id, tenant, scenario, method,
+            job = SweepJob(self, job_id, tenant, scenario,
+                           (f"live:{_live['method']}" if _live is not None
+                            else method),
                            deadline_sec, ordinal, priority=priority,
                            profile=profile)
             job._fault_entry = entry
+            job._live_query = _live
             if self._journal is not None:
                 # journal BEFORE registering: an un-journalable
                 # submission must fail synchronously (the caller is owed
@@ -806,7 +854,7 @@ class SweepService:
                 try:
                     self._journal.append({
                         "type": "submit", "job": job_id, "tenant": tenant,
-                        "method": method, "priority": int(priority),
+                        "method": job.method, "priority": int(priority),
                         "partners_count": int(scenario.partners_count)})
                 except OSError as e:
                     raise ServiceError(
@@ -815,11 +863,99 @@ class SweepService:
             self._jobs[job_id] = job
             obs_metrics.counter("service.jobs_accepted").inc()
             obs_trace.event("service.submit", tenant=tenant, job=job_id,
-                            method=method, ordinal=ordinal,
+                            method=job.method, ordinal=ordinal,
                             priority=int(priority))
             self._queue.push(job)
             self._lock.notify_all()
         return job
+
+    # -- the live contributivity tier ------------------------------------
+
+    def live_game(self, scenario, tenant: str = "tenant0",
+                  journal_path=None, **kw):
+        """Create (or return) the tenant's RESIDENT live game
+        (mplc_tpu/live/): the recorded round history stays in this
+        process across queries, so `submit_live` answers without a
+        sweep. One game per tenant; a second call returns the existing
+        game (the scenario/journal arguments of the first call win)."""
+        from ..live import LiveGame
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed("service is shut down")
+            game = self._live_games.get(tenant)
+        if game is not None:
+            return game
+        # creation serialized OUTSIDE the scheduler lock (engine/data
+        # construction can take seconds and must not stall every quantum
+        # pick) but under its own lock: two racing callers must not BOTH
+        # construct — the loser would leak an open journal handle and
+        # append a duplicate live_init record to the same WAL
+        with self._live_create_lock:
+            with self._lock:
+                game = self._live_games.get(tenant)
+            if game is None:
+                game = LiveGame(scenario, tenant=tenant,
+                                journal_path=journal_path, **kw)
+                with self._lock:
+                    self._live_games[tenant] = game
+        return game
+
+    def append_round(self, tenant: str, deltas, weights) -> int:
+        """Append one aggregation round to the tenant's resident game
+        (LiveGame.append_round — journaled, round-stamp invalidation).
+        Returns the game's round-stamp after the append."""
+        game = self._live_games.get(tenant)
+        if game is None:
+            raise ServiceError(
+                f"no live game for tenant {tenant!r} — call live_game() "
+                "first")
+        return game.append_round(deltas, weights)
+
+    def submit_live(self, tenant: str, method: str = "GTG-Shapley",
+                    deadline_sec: "float | None" = None,
+                    job_id: "str | None" = None,
+                    priority: "int | None" = None,
+                    prune: "float | None" = None,
+                    **method_kw) -> SweepJob:
+        """Submit a low-latency live contributivity query against the
+        tenant's resident game. Rides the EXISTING admission/priority/
+        SLO machinery — bounded queue, tier-weighted quanta, overload
+        shedding, deadlines, journaled terminals — as its own job class:
+        by default one priority tier ABOVE the batch default (live
+        queries are the latency-sensitive traffic the governor protects)
+        with `MPLC_TPU_LIVE_QUERY_DEADLINE_SEC` as the default deadline
+        (0/unset = none; an explicit `deadline_sec` wins). `method` is
+        "exact" | "GTG-Shapley" | "SVARM"; `prune` is the DPVS threshold
+        tau (None = the env default). The answer is `job.result()` (the
+        scores) with the full `LiveQueryResult` on `job.live_result`."""
+        game = self._live_games.get(tenant)
+        if game is None:
+            raise ServiceError(
+                f"no live game for tenant {tenant!r} — call live_game() "
+                "first")
+        # validate what the quantum would deterministically reject
+        # SYNCHRONOUSLY (same rule as submit()'s method check): a job
+        # that can only ever ValueError must not burn the retry budget,
+        # quarantine and dump a postmortem for a caller mistake
+        from ..live import MAX_EXACT_PARTNERS
+        if (method in ("exact", "Shapley values")
+                and game.engine.partners_count > MAX_EXACT_PARTNERS):
+            raise ValueError(
+                f"live exact queries are limited to {MAX_EXACT_PARTNERS} "
+                f"partners (this game has {game.engine.partners_count}) "
+                "— use GTG-Shapley or SVARM")
+        if prune is not None and not 0.0 <= float(prune) <= 1.0:
+            raise ValueError(
+                f"prune tau must be in [0, 1], got {prune}")
+        if priority is None:
+            priority = self._priority_default + 1
+        if deadline_sec is None and self._live_deadline > 0:
+            deadline_sec = self._live_deadline
+        return self.submit(game.scenario, tenant=tenant,
+                           deadline_sec=deadline_sec, job_id=job_id,
+                           priority=priority,
+                           _live={"game": game, "method": method,
+                                  "prune": prune, "kw": method_kw})
 
     # -- scheduling loop -------------------------------------------------
 
@@ -972,6 +1108,8 @@ class SweepService:
         self._worker = None
         if self._journal is not None:
             self._journal.close()
+        for game in self._live_games.values():
+            game.close()
         obs_export.unregister(self._provider_key)
 
     def __enter__(self) -> "SweepService":
@@ -1083,26 +1221,50 @@ class SweepService:
         meter_before = None
         try:
             if job.engine is None:
-                self._build_engine(job)
+                if job._live_query is not None:
+                    self._attach_live_engine(job)
+                else:
+                    self._build_engine(job)
             eng = job.engine
             meter = getattr(eng, "device_meter", None)
-            meter_before = meter.snapshot() if meter is not None else None
+            # live quanta snapshot/bill inside the GAME lock instead —
+            # the resident engine's meter is shared with sibling quanta
+            meter_before = (meter.snapshot()
+                            if meter is not None
+                            and job._live_query is None else None)
             b0, e0 = eng._batch_ordinal, eng.epochs_trained
             s0, p0 = eng.samples_trained, job.packed_batches
             c0 = len(eng.charac_fct_values)
-            if job.method == "Shapley values":
+            if job._live_query is not None:
+                finished = self._run_live_quantum(job)
+            elif job.method == "Shapley values":
                 finished = self._run_exact_slice(job)
             else:
                 finished = self._run_method_quantum(job)
-            dev_sec, dev_basis = self._meter_quantum(job, meter_before)
+            if job._live_query is not None:
+                dev_sec, dev_basis = job._live_billed or (0.0, None)
+                job._live_billed = None
+            else:
+                dev_sec, dev_basis = self._meter_quantum(job, meter_before)
             meter_before = None  # billed; the except paths must not re-bill
-            span.attrs.update(
-                batches=eng._batch_ordinal - b0,
-                coalitions=len(eng.charac_fct_values) - c0,
-                epochs=eng.epochs_trained - e0,
-                samples=eng.samples_trained - s0,
-                packed_batches=job.packed_batches - p0,
-                device_sec=dev_sec, device_basis=dev_basis)
+            if job._live_query is not None:
+                # counters snapshotted under the GAME lock (sibling
+                # quanta share the resident engine; unlocked deltas
+                # would report their work too); coalitions = this
+                # query's reconstruction evaluations
+                counts = job._live_counts or {}
+                job._live_counts = None
+                span.attrs.update(
+                    **counts, packed_batches=job.packed_batches - p0,
+                    device_sec=dev_sec, device_basis=dev_basis)
+            else:
+                span.attrs.update(
+                    batches=eng._batch_ordinal - b0,
+                    coalitions=len(eng.charac_fct_values) - c0,
+                    epochs=eng.epochs_trained - e0,
+                    samples=eng.samples_trained - s0,
+                    packed_batches=job.packed_batches - p0,
+                    device_sec=dev_sec, device_basis=dev_basis)
             span.end()
             obs_metrics.histogram(
                 "service.slice_sec", tenant=job.tenant).observe(
@@ -1143,8 +1305,15 @@ class SweepService:
         delta into the trace stream. Without it the report's per-tenant
         device_seconds/cost_share would silently disagree with the
         /metrics counter and the journal for exactly the tenants whose
-        faults consumed device time."""
-        dsec, dbasis = self._meter_quantum(job, before)
+        faults consumed device time. Live quanta were already billed
+        inside the game lock (`_run_live_quantum`'s finally) — their
+        stashed delta feeds the replacement event here instead of a
+        second metering pass."""
+        if job._live_query is not None:
+            dsec, dbasis = job._live_billed or (0.0, None)
+            job._live_billed = None
+        else:
+            dsec, dbasis = self._meter_quantum(job, before)
         if dsec:
             obs_trace.event(
                 "service.slice", dur=span.duration or 0.0,
@@ -1348,6 +1517,83 @@ class SweepService:
             for subset, value in fresh])
         job._push_stream(fresh)
 
+    def _attach_live_engine(self, job: SweepJob) -> None:
+        """Point a live-query job at its tenant's RESIDENT game engine
+        (shared across queries — never rebuilt, never released). The
+        journal cursor is parked at the engine's current memo size: live
+        answers are reconstruction-derived and journaled by the game's
+        OWN WAL, so the service WAL must not re-journal the shared
+        engine's exact memo under this job."""
+        eng = job._live_query["game"].engine
+        job.engine = eng
+        job.subsets = None
+        job._journal_cursor = len(eng.charac_fct_values)
+
+    def _run_live_quantum(self, job: SweepJob) -> bool:
+        """One live query runs as ONE quantum (like the estimator
+        methods): the resident game answers from reconstruction — zero
+        training batches — while the heartbeat and cooperative deadline
+        ride the shared engine's per-batch progress hook for the
+        quantum's duration.
+
+        The whole quantum body holds the GAME's lock: the engine, its
+        progress hook, its device meter and the evaluator memo are all
+        shared with every other quantum of this tenant, so a sibling
+        worker's live quantum must not interleave — it would clobber
+        this quantum's hook (driving the wrong job's heartbeat/deadline)
+        and its device work would land inside both quanta's meter
+        windows (double-billed device-seconds). The meter snapshot is
+        therefore taken INSIDE the lock (the generic quantum pre-snapshot
+        is skipped for live jobs) and the billed delta is stashed on the
+        job for the slice span / failure-billing paths."""
+        spec = job._live_query
+        game = spec["game"]
+        eng = job.engine
+
+        def on_batch(done_in_group, remaining, slot_count,
+                     _job=job) -> None:
+            self._on_batch(_job, slot_count)
+
+        with game._lock:
+            meter = getattr(eng, "device_meter", None)
+            before = meter.snapshot() if meter is not None else None
+            # batch/epoch/sample accounting snapshotted INSIDE the lock
+            # too: the shared engine's counters advance under sibling
+            # quanta, and this quantum's slice span must report only its
+            # own work (same rule as the meter)
+            b0, e0, s0 = (eng._batch_ordinal, eng.epochs_trained,
+                          eng.samples_trained)
+            prev = eng.progress
+            eng.progress = on_batch
+            try:
+                result = game.query(method=spec["method"],
+                                    prune=spec.get("prune"),
+                                    **(spec.get("kw") or {}))
+            finally:
+                eng.progress = prev
+                # bill inside the lock: the window contains exactly this
+                # quantum's device work (a faulted/cancelled query pays
+                # for what it consumed, like any quantum)
+                job._live_billed = self._meter_quantum(job, before)
+                job._live_counts = {
+                    "batches": eng._batch_ordinal - b0,
+                    "epochs": eng.epochs_trained - e0,
+                    "samples": eng.samples_trained - s0,
+                }
+            job._live_counts["coalitions"] = result.evaluations
+            # the completed query's v(S) table, snapshotted while appends
+            # are still excluded — _complete must not touch the shared
+            # evaluator outside the lock (a racing append_round would
+            # reset_recorded under it)
+            job.values = dict(game._evaluator().values)
+        job.scores = np.asarray(result.scores)
+        job.live_result = result
+        # stream the answer as one terminal item so stream() consumers
+        # (and the ttfv SLO histogram) see live answers like batch values
+        job._push_stream([(("live", spec["method"]),
+                           [float(x) for x in result.scores])])
+        return True
+
     # -- the two execution shapes ---------------------------------------
 
     def _run_exact_slice(self, job: SweepJob) -> bool:
@@ -1402,6 +1648,11 @@ class SweepService:
         eng = job.engine
         if eng is None:
             return
+        if job._live_query is not None:
+            # the engine belongs to the tenant's RESIDENT live game —
+            # shared across queries; drop only this handle's reference
+            job.engine = None
+            return
         eng.progress = None
         for attr in ("stacked", "val", "test", "_cpu_data", "multi_pipe",
                      "single_pipe", "_pipe2d", "program_bank"):
@@ -1427,7 +1678,12 @@ class SweepService:
             from ..contrib.shapley import shapley_from_characteristic
             job.scores = shapley_from_characteristic(
                 job.engine.partners_count, job.engine.charac_fct_values)
-        job.values = dict(job.engine.charac_fct_values)
+        if job._live_query is None:
+            job.values = dict(job.engine.charac_fct_values)
+        # live jobs: `job.values` was snapshotted from the game's
+        # evaluator UNDER the game lock in _run_live_quantum — touching
+        # the shared evaluator here would race a sibling append_round's
+        # reset_recorded (and a concurrent query's memo inserts)
         job.status = "completed"
         # the terminal record carries the job's metered device-seconds:
         # replay restores per-tenant billing across restarts

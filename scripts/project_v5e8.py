@@ -194,6 +194,14 @@ def load_telemetry_service(path):
     return _telemetry_row(path, "service")
 
 
+def load_telemetry_live(path):
+    """The live contributivity row (BENCH_CONFIG=8): query/memo-hit
+    counts, reconstruction evaluations, DPVS-pruned coalitions and
+    fresh-query latency quantiles. Batch-only runs (and pre-live
+    schemas) load as {}."""
+    return _telemetry_row(path, "live")
+
+
 def parse_batch_times(log_path):
     """Per-slot-size batch durations (s), from either input kind:
 
@@ -481,6 +489,24 @@ def main():
                   + (f" cost_share[{shares}]" if shares else "")
                   + " — multi-tenant run: per-batch times below include "
                     "scheduler slicing and per-value journal fsyncs")
+        lv = load_telemetry_live(args.telemetry)
+        if lv.get("queries"):
+            # live-tier sidecars (BENCH_CONFIG=8): sub-second-query
+            # evidence — fresh-query latency vs the memoized warm path,
+            # and how much DPVS pruning cut the evaluation schedule. A
+            # projection from a live sidecar describes QUERY latency, not
+            # sweep throughput.
+            q = lv.get("query_s") or {}
+            p50 = q.get("p50")
+            print(f"measured live: queries={lv['queries']} "
+                  f"memo_hits={lv.get('memo_hits', 0)} "
+                  f"evaluations={lv.get('evaluations', 0)} "
+                  f"pruned={lv.get('pruned_coalitions', 0)} "
+                  f"rounds={lv.get('rounds_resident', '?')} "
+                  "fresh-query p50="
+                  + (f"{p50:.3f}s" if p50 is not None else "n/a")
+                  + " — latency-vs-rounds table in the sidecar's "
+                    "latency_vs_rounds block")
         t = load_telemetry_trust(args.telemetry)
         if t.get("ensemble"):
             # the sweep's answer-trust view (absent in single-seed,

@@ -73,6 +73,30 @@ def test_static_scan_covers_the_service_package():
         assert constants.ENV_KNOBS.get(knob) == "workload", knob
 
 
+def test_static_scan_covers_the_live_package():
+    """Same obligation for the live tier (mplc_tpu/live/) as PR 9
+    established for service/: the knob scan, donation lint and span scan
+    all walk `mplc_tpu/` by rglob, so the live subpackage must be inside
+    that walk, its knobs registered workload-class, and its span names
+    in the registry — a knob or span added there has to fail these
+    checks, not hide in an unscanned directory."""
+    live_dir = REPO / "mplc_tpu" / "live"
+    assert live_dir.is_dir()
+    scanned = set(sorted((REPO / "mplc_tpu").rglob("*.py")))
+    live_files = set(live_dir.glob("*.py"))
+    assert live_files and live_files <= scanned
+    # the live knobs reshape what a live-query bench run computes
+    # (pruning schedule, reconstruction depth, deadline survival)
+    for knob in ("MPLC_TPU_LIVE_PRUNE_TAU", "MPLC_TPU_LIVE_MAX_ROUNDS",
+                 "MPLC_TPU_LIVE_QUERY_DEADLINE_SEC"):
+        assert constants.ENV_KNOBS.get(knob) == "workload", knob
+    # and the tier's trace vocabulary is registered (consumers: the
+    # report's live row, the Perfetto exporter)
+    from mplc_tpu.obs.trace import SPAN_REGISTRY
+    for name in ("live.query", "live.append", "live.recover"):
+        assert name in SPAN_REGISTRY, name
+
+
 def test_registry_has_no_stale_entries():
     stale = set(constants.ENV_KNOBS) - _knobs_in_sources()
     assert not stale, (

@@ -1,0 +1,571 @@
+"""The live contributivity tier (mplc_tpu/live/): resident incremental
+games, sub-second queries, DPVS pruning, journal recovery, and the
+service's low-latency live job class.
+
+The contract under test:
+
+1. **Warm path = zero training.** `LiveGame.query` on a game fed by
+   `append_round` completes with zero training batches — counter-asserted
+   via `engine.partner_passes` and the `engine.batch` events (all
+   `eval_only`) — and repeated queries at an unchanged round-stamp are
+   memo hits whose latency does not scale with resident rounds.
+2. **The incremental invariant.** Append K rounds one-at-a-time (querying
+   in between) ≡ bit-identical to appending all K up front, for exact,
+   GTG-Shapley and SVARM; a NON-invalidating (all-zero-weight) append
+   preserves memoized values bit-identically; an invalidating append
+   advances the round-stamp and a stale result is never served.
+3. **Journal recovery.** kill→restart (a fresh LiveGame on the same WAL)
+   answers queries bit-identically; a different game's journal is
+   refused.
+4. **DPVS pruning.** Off (tau=0) ⇒ bit-identical to the unpruned path;
+   on ⇒ coalition evaluations measurably reduced (counter-asserted) with
+   rank agreement inside the pinned Kendall-tau bound — including the
+   >=20-partner (33, multi-word bitmask) smoke through the real engine.
+5. **Service integration.** submit_live rides the existing admission/
+   priority machinery one tier above the batch default, answers equal
+   the direct query, and the resident game appears on /varz.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from helpers import build_scenario, cluster_mlp_dataset
+from mplc_tpu.contrib.shapley import (kendall_tau, powerset_order,
+                                      shapley_from_characteristic)
+from mplc_tpu.live import (LiveGame, LiveGameFull, info_scores,
+                           low_information)
+from mplc_tpu.obs import metrics
+from mplc_tpu.obs import trace as obs_trace
+from mplc_tpu.obs.report import format_report, sweep_report
+
+
+# ---------------------------------------------------------------------------
+# scenario + synthetic-round helpers (no training: rounds are appended)
+# ---------------------------------------------------------------------------
+
+def _scenario_3p(seed=3):
+    return build_scenario(
+        partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+        dataset=cluster_mlp_dataset(n=240, seed=9, scale=1.0),
+        epoch_count=2, minibatch_count=2, seed=seed)
+
+
+def _synth_rounds(game, k, seed=0, scale=0.08):
+    """k deterministic synthetic aggregation rounds shaped like the
+    game's model params."""
+    rng = np.random.default_rng(seed)
+    P = game.engine.partners_count
+    rounds = []
+    for _ in range(k):
+        deltas = jax.tree_util.tree_map(
+            lambda l: rng.normal(0, scale, (P,) + l.shape).astype(l.dtype),
+            game._init_params)
+        w = rng.dirichlet(np.ones(P)).astype(np.float32)
+        rounds.append((deltas, w))
+    return rounds
+
+
+def _zero_round(game):
+    P = game.engine.partners_count
+    deltas = jax.tree_util.tree_map(
+        lambda l: np.zeros((P,) + l.shape, l.dtype), game._init_params)
+    return deltas, np.zeros(P, np.float32)
+
+
+@pytest.fixture(scope="module")
+def scen3():
+    return _scenario_3p()
+
+
+# ---------------------------------------------------------------------------
+# 1. warm path: zero training batches, memoized non-scaling queries
+# ---------------------------------------------------------------------------
+
+def test_warm_query_zero_training_and_memo(scen3):
+    game = LiveGame(scen3)
+    for deltas, w in _synth_rounds(game, 3, seed=1):
+        game.append_round(deltas, w)
+    metrics.reset()
+    with obs_trace.collect() as records:
+        r1 = game.query("exact")
+    snap = metrics.snapshot()
+    # zero training: no partner passes, every engine.batch eval-only
+    assert snap["counters"].get("engine.partner_passes", 0) == 0
+    assert snap["counters"].get("engine.epochs_trained", 0) == 0
+    batches = [rec for rec in records if rec["name"] == "engine.batch"]
+    assert batches and all(b["attrs"].get("eval_only") for b in batches)
+    assert all(b["attrs"]["partner_passes"] == 0 for b in batches)
+    assert r1.evaluations > 0 and r1.stamp == game.round_stamp
+
+    # warm re-query: a memo hit — the SAME result object, no device work
+    with obs_trace.collect() as records2:
+        r2 = game.query("exact")
+    assert r2 is r1
+    assert not [rec for rec in records2 if rec["name"] == "engine.batch"]
+    q = [rec for rec in records2 if rec["name"] == "live.query"]
+    assert len(q) == 1 and q[0]["attrs"]["memo_hit"] is True
+    # CPU-tier latency pin: the memoized path answers without touching
+    # the reconstruction stack at all, so it cannot scale with rounds
+    assert q[0]["dur"] < 0.05
+
+
+def test_non_invalidating_append_preserves_memo_bit_identically(scen3):
+    game = LiveGame(scen3)
+    for deltas, w in _synth_rounds(game, 2, seed=2):
+        game.append_round(deltas, w)
+    r1 = game.query("exact")
+    stamp = game.round_stamp
+    # pile on zero-weight rounds: resident count grows, stamp does not
+    for _ in range(4):
+        assert game.append_round(*_zero_round(game)) == stamp
+    assert game.rounds_resident == 6 and game.round_stamp == stamp
+    with obs_trace.collect() as records:
+        r2 = game.query("exact")
+    assert r2 is r1  # bit-identical survival: the very same result
+    assert not [rec for rec in records if rec["name"] == "engine.batch"]
+
+
+def test_invalidating_append_never_serves_stale(scen3):
+    game = LiveGame(scen3)
+    rounds = _synth_rounds(game, 3, seed=4)
+    game.append_round(*rounds[0])
+    r1 = game.query("exact")
+    game.append_round(*rounds[1])
+    assert r1.stamp < game.round_stamp  # r1 is now STALE
+    with obs_trace.collect() as records:
+        r2 = game.query("exact")
+    assert r2 is not r1 and r2.stamp == game.round_stamp
+    # the recompute really ran device evaluations over the new stack
+    assert [rec for rec in records if rec["name"] == "engine.batch"]
+    assert r2.evaluations > 0
+
+
+# ---------------------------------------------------------------------------
+# 2. the incremental invariant: one-at-a-time == all-up-front
+# ---------------------------------------------------------------------------
+
+def test_incremental_equals_upfront_for_all_methods(scen3):
+    game_a = LiveGame(scen3)
+    game_b = LiveGame(scen3)
+    rounds = _synth_rounds(game_a, 3, seed=5)
+    kw = {"exact": {},
+          "GTG-Shapley": dict(sv_accuracy=1.0, min_iter=8, perm_batch=4),
+          "SVARM": dict(budget=24, block=8)}
+    for deltas, w in rounds:
+        game_a.append_round(deltas, w)
+        game_a.query("exact")  # interleaved queries must not perturb
+    for deltas, w in rounds:
+        game_b.append_round(deltas, w)
+    for method in ("exact", "GTG-Shapley", "SVARM"):
+        ra = game_a.query(method, **kw[method])
+        rb = game_b.query(method, **kw[method])
+        np.testing.assert_array_equal(ra.scores, rb.scores), method
+
+
+# ---------------------------------------------------------------------------
+# 3. journal: kill -> restart -> query equality; foreign journals refused
+# ---------------------------------------------------------------------------
+
+def test_journal_kill_restart_query_equality(tmp_path):
+    wal = str(tmp_path / "live_wal.jsonl")
+    sc = _scenario_3p()
+    game = LiveGame.from_recording(sc, journal_path=wal)
+    for deltas, w in _synth_rounds(game, 2, seed=6):
+        game.append_round(deltas, w)
+    r = game.query("exact")
+    r_gtg = game.query("GTG-Shapley", sv_accuracy=1.0, min_iter=8,
+                       perm_batch=4)
+    game.close()  # the "kill": the process's in-memory game is gone
+
+    sc2 = _scenario_3p()
+    metrics.reset()
+    restored = LiveGame(sc2, journal_path=wal)
+    assert restored.rounds_resident == game.rounds_resident
+    assert restored.round_stamp == game.round_stamp
+    assert metrics.snapshot()["counters"].get("live.games_recovered") == 1
+    r2 = restored.query("exact")
+    np.testing.assert_array_equal(r2.scores, r.scores)
+    r2_gtg = restored.query("GTG-Shapley", sv_accuracy=1.0, min_iter=8,
+                            perm_batch=4)
+    np.testing.assert_array_equal(r2_gtg.scores, r_gtg.scores)
+    restored.close()
+
+
+def test_journal_partner_mismatch_refused(tmp_path):
+    wal = str(tmp_path / "live_wal.jsonl")
+    sc = _scenario_3p()
+    game = LiveGame(sc, journal_path=wal)
+    game.append_round(*_synth_rounds(game, 1, seed=7)[0])
+    game.close()
+    sc4 = build_scenario(
+        partners_count=4, amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
+        dataset=cluster_mlp_dataset(n=240, seed=9, scale=1.0),
+        epoch_count=2, minibatch_count=2)
+    with pytest.raises(ValueError, match="refusing to restore"):
+        LiveGame(sc4, journal_path=wal)
+
+
+def test_journal_model_mismatch_refused(tmp_path):
+    wal = str(tmp_path / "live_wal.jsonl")
+    sc = _scenario_3p()
+    game = LiveGame(sc, journal_path=wal)
+    game.append_round(*_synth_rounds(game, 1, seed=24)[0])
+    game.close()
+    # same partner count, different model name: same-shape architectures
+    # must not silently answer the wrong game
+    import dataclasses
+    sc2 = _scenario_3p()
+    eng2 = LiveGame(sc2).engine
+    eng2.model = dataclasses.replace(eng2.model, name="other_model")
+    sc3 = _scenario_3p()
+    sc3._charac_engine = eng2
+    with pytest.raises(ValueError, match="model"):
+        LiveGame(sc3, engine=eng2, journal_path=wal)
+
+
+def test_varz_live_games_redacted_for_other_tenants():
+    from mplc_tpu.obs.export import redact_varz
+
+    doc = {"live_games": {
+        "acme": {"tenant": "acme", "rounds_resident": 7, "round_stamp": 3,
+                 "queries": 2, "results_cached": 1, "max_rounds": 4096,
+                 "journal": "/secret/path/wal.jsonl"},
+        "beta": {"tenant": "beta", "rounds_resident": 1, "round_stamp": 1,
+                 "queries": 0, "results_cached": 0, "max_rounds": 4096,
+                 "journal": None}}}
+    red = redact_varz(doc, viewer="beta", key="master")
+    assert "beta" in red["live_games"]  # the viewer keeps its own row
+    assert red["live_games"]["beta"]["journal"] is None
+    others = [v for k, v in red["live_games"].items() if k != "beta"]
+    assert len(others) == 1 and others[0]["redacted"] is True
+    body = str(red)
+    assert "acme" not in body and "/secret/path" not in body
+
+
+def test_from_recording_on_restored_journal_does_not_double(tmp_path):
+    wal = str(tmp_path / "live_wal.jsonl")
+    sc = _scenario_3p()
+    game = LiveGame.from_recording(sc, journal_path=wal)
+    n = game.rounds_resident
+    assert n > 0
+    game.close()
+    game2 = LiveGame.from_recording(_scenario_3p(), journal_path=wal)
+    assert game2.rounds_resident == n  # restored, not re-recorded
+    game2.close()
+
+
+# ---------------------------------------------------------------------------
+# caps & validation
+# ---------------------------------------------------------------------------
+
+def test_resident_round_cap(scen3, monkeypatch):
+    game = LiveGame(scen3, max_rounds=2)
+    rounds = _synth_rounds(game, 3, seed=8)
+    game.append_round(*rounds[0])
+    game.append_round(*rounds[1])
+    with pytest.raises(LiveGameFull, match="MPLC_TPU_LIVE_MAX_ROUNDS"):
+        game.append_round(*rounds[2])
+    # the env knob is the construction-time default
+    monkeypatch.setenv("MPLC_TPU_LIVE_MAX_ROUNDS", "1")
+    game2 = LiveGame(scen3)
+    assert game2.max_rounds == 1
+
+
+def test_append_round_validates_shapes(scen3):
+    game = LiveGame(scen3)
+    deltas, w = _synth_rounds(game, 1, seed=9)[0]
+    bad = jax.tree_util.tree_map(lambda l: l[:1], deltas)  # wrong P axis
+    with pytest.raises(ValueError, match="delta leaf has shape"):
+        game.append_round(bad, w)
+    with pytest.raises(ValueError):
+        game.query("no-such-method")
+
+
+def test_exact_query_partner_bound(scen3):
+    game = LiveGame(scen3)
+    game.engine.partners_count = 17  # force past the exact bound
+    try:
+        with pytest.raises(ValueError, match="GTG-Shapley or"):
+            game.query("exact")
+    finally:
+        game.engine.partners_count = 3
+
+
+# ---------------------------------------------------------------------------
+# 4. DPVS pruning
+# ---------------------------------------------------------------------------
+
+def test_dpvs_score_arithmetic():
+    # 2 partners, 2 rounds, single scalar-leaf "params": s_p = sum |w| * |d|
+    rounds = [({"w": np.array([[2.0], [0.5]])}, np.array([0.5, 0.5])),
+              ({"w": np.array([[1.0], [0.0]])}, np.array([1.0, 0.0]))]
+    s = info_scores(rounds, 2)
+    np.testing.assert_allclose(s, [0.5 * 2.0 + 1.0 * 1.0, 0.5 * 0.5])
+    assert low_information(s, 0.5) == frozenset({1})
+    # the max scorer is never pruned; tau=0 and all-zero scores prune nobody
+    assert low_information(s, 1.0) == frozenset({1})
+    assert low_information(s, 0.0) == frozenset()
+    assert low_information(np.zeros(3), 0.9) == frozenset()
+
+
+def test_prune_off_bit_identical_to_unpruned_reconstruction(scen3):
+    """The exactness-preserving off switch: tau = 0 values equal an
+    independently-driven unpruned reconstruction of the same game,
+    bit-identically."""
+    game = LiveGame(scen3)
+    for deltas, w in _synth_rounds(game, 2, seed=10):
+        game.append_round(deltas, w)
+    r = game.query("exact", prune=0.0)
+    recon = game._evaluator()
+    recon.evaluate(powerset_order(3))
+    manual = np.asarray(shapley_from_characteristic(3, recon.values))
+    np.testing.assert_array_equal(r.scores, manual)
+
+
+def test_prune_reduces_evaluations_with_rank_agreement(scen3):
+    """6-partner synthetic game where two partners contribute
+    near-nothing: pruning on must evaluate measurably fewer coalitions
+    (counter-asserted), zero the low-information partners, and keep rank
+    agreement with the unpruned answer."""
+    sc = build_scenario(
+        partners_count=6, amounts_per_partner=[1 / 6.0] * 6,
+        dataset=cluster_mlp_dataset(n=360, seed=9, scale=1.0),
+        epoch_count=2, minibatch_count=2)
+    game = LiveGame(sc)
+    rng = np.random.default_rng(11)
+    P = 6
+    # low-information partners carry proportionally low aggregation
+    # weight too — the data-volume-weighted FedAvg regime DPVS's
+    # negligible-marginal assumption rests on (a tiny-delta partner with
+    # a LARGE weight would still dilute everyone else's renormalized
+    # weights, and pruning it would not approximate the game)
+    scale = np.array([1.0, 0.8, 0.6, 0.4, 1e-5, 1e-5])
+    weights = (scale / scale.sum()).astype(np.float32)
+    for _ in range(3):
+        deltas = jax.tree_util.tree_map(
+            lambda l: (rng.normal(0, 0.08, (P,) + l.shape)
+                       * scale.reshape((P,) + (1,) * len(l.shape))
+                       ).astype(l.dtype),
+            game._init_params)
+        game.append_round(deltas, weights)
+    game_b = LiveGame(sc)  # a twin on the same engine, fresh evaluator
+    for deltas, w in game.round_history():
+        game_b.append_round(deltas, w)
+
+    metrics.reset()
+    pruned = game.query("exact", prune=0.05)
+    unpruned = game_b.query("exact", prune=0.0)
+    assert pruned.low_info == (4, 5)
+    assert pruned.pruned_coalitions > 0
+    assert metrics.snapshot()["counters"].get(
+        "live.pruned_coalitions", 0) == pruned.pruned_coalitions
+    # measurably fewer device evaluations: 2^4-1 projections vs 2^6-1
+    assert pruned.evaluations == 15 and unpruned.evaluations == 63
+    np.testing.assert_array_equal(pruned.scores[4:], 0.0)
+    # rank agreement: exact among the informative partners; looser over
+    # the full vector — the unpruned path credits every partner a
+    # baseline-accuracy share from the empty-prefix term (a tiny-delta
+    # singleton reconstructs to the INIT model, which scores chance
+    # accuracy), exactly the null-player artifact pruning zeroes out
+    assert kendall_tau(unpruned.scores[:4], pruned.scores[:4]) >= 0.8
+    assert kendall_tau(unpruned.scores, pruned.scores) >= 0.5
+
+
+def test_live_game_smoke_33_partners_with_pruning():
+    """The >=20-partner smoke: a 33-partner game (multi-word bitmask
+    plumbing — two uint32 fold words) recorded end-to-end through the
+    real engine, queried through LiveGame.query with DPVS pruning on.
+    Pinned: pruning reduces coalition evaluations and rank-agrees with
+    the unpruned answer (Kendall tau >= 0.6 — measured 0.79 on the CPU
+    tier; the 6 deliberately-tiny partners are the pruned set)."""
+    P = 33
+    amounts = [float(i + 8) for i in range(27)] + [1.0] * 6
+    amounts = [a / sum(amounts) for a in amounts]
+    sc = build_scenario(
+        partners_count=P, amounts_per_partner=amounts,
+        dataset=cluster_mlp_dataset(n=1600, seed=13, scale=1.5),
+        epoch_count=2, minibatch_count=2)
+    game = LiveGame.from_recording(sc)
+    assert game.engine._rng_word_count == 2  # the multi-word regime
+    s = info_scores(game.round_history(), P)
+    assert low_information(s, 0.1) == frozenset(range(27, 33))
+    kw = dict(sv_accuracy=1.0, min_iter=8, perm_batch=8, truncation=0.0)
+    unpruned = game.query("GTG-Shapley", prune=0.0, **kw)
+    pruned = game.query("GTG-Shapley", prune=0.1, **kw)
+    assert pruned.low_info == tuple(range(27, 33))
+    assert pruned.pruned_coalitions > 0
+    assert 0 < pruned.evaluations < unpruned.evaluations
+    np.testing.assert_array_equal(pruned.scores[27:], 0.0)
+    assert kendall_tau(unpruned.scores, pruned.scores) >= 0.6
+
+
+# ---------------------------------------------------------------------------
+# program bank: recon executables under shared-scope keys
+# ---------------------------------------------------------------------------
+
+def test_recon_programs_banked_across_same_shape_games():
+    from mplc_tpu.contrib.bank import reset_bank
+
+    reset_bank()  # earlier tests of the same SHAPE already banked these
+    sc = _scenario_3p(seed=21)
+    game1 = LiveGame(sc)
+    rounds = _synth_rounds(game1, 2, seed=12)
+    for deltas, w in rounds:
+        game1.append_round(deltas, w)
+    metrics.reset()
+    r1 = game1.query("exact")
+    snap1 = metrics.snapshot()["counters"]
+    compiles = snap1.get("bank.compiles", 0)
+    assert compiles >= 1  # the recon programs were AOT-banked
+    # a second game of the same shape: its evaluator is fresh (cold memo)
+    # but the banked executables serve it with zero new compiles
+    game2 = LiveGame(sc)
+    for deltas, w in rounds:
+        game2.append_round(deltas, w)
+    metrics.reset()
+    r2 = game2.query("exact")
+    snap2 = metrics.snapshot()["counters"]
+    assert snap2.get("bank.compiles", 0) == 0
+    assert snap2.get("bank.hits", 0) >= 1
+    np.testing.assert_array_equal(r1.scores, r2.scores)
+
+
+# ---------------------------------------------------------------------------
+# 5. service integration: the low-latency live job class
+# ---------------------------------------------------------------------------
+
+def test_service_live_query_job(monkeypatch):
+    from mplc_tpu.service import ServiceError, SweepService
+
+    monkeypatch.setenv("MPLC_TPU_LIVE_QUERY_DEADLINE_SEC", "30")
+    svc = SweepService(start=False)
+    with pytest.raises(ServiceError, match="no live game"):
+        svc.submit_live("tenantX")
+    with pytest.raises(ServiceError, match="no live game"):
+        svc.append_round("tenantX", None, None)
+
+    sc = _scenario_3p(seed=22)
+    game = svc.live_game(sc, tenant="tenantX")
+    assert svc.live_game(sc, tenant="tenantX") is game  # one per tenant
+    for deltas, w in _synth_rounds(game, 2, seed=13):
+        svc.append_round("tenantX", deltas, w)
+    with pytest.raises(ValueError, match="unknown live query method"):
+        svc.submit_live("tenantX", method="TMCS")
+    # deterministic caller mistakes fail at SUBMIT, never as a
+    # retried-then-quarantined job fault
+    with pytest.raises(ValueError, match=r"\[0, 1\]"):
+        svc.submit_live("tenantX", prune=5.0)
+    game.engine.partners_count = 17
+    try:
+        with pytest.raises(ValueError, match="limited to"):
+            svc.submit_live("tenantX", method="exact")
+    finally:
+        game.engine.partners_count = 3
+
+    with obs_trace.collect() as records:
+        job = svc.submit_live("tenantX", method="exact")
+        # the low-latency class: one tier above the batch default
+        assert job.priority == svc._priority_default + 1
+        assert job.deadline_sec == 30.0
+        assert job.method == "live:exact"
+        svc.run_until_idle()
+    scores = job.result(timeout=5)
+    direct = game.query("exact")
+    np.testing.assert_array_equal(np.asarray(scores), direct.scores)
+    assert job.live_result is not None
+    assert job.live_result.stamp == game.round_stamp
+    # the resident game survives job completion (engines are shared,
+    # never released) and shows on /varz
+    assert game.engine.stacked is not None
+    varz = svc.varz_view()
+    assert varz["live_games"]["tenantX"]["rounds_resident"] == 2
+    import json
+    json.dumps(varz["live_games"])  # the /varz row must serialize
+    # the job's quantum emitted the usual service spans + the live row
+    rep = sweep_report(records)
+    assert rep["live"]["queries"] >= 1
+    assert "live" in format_report(rep)
+    svc.shutdown(drain=False)
+
+
+def test_prune_tau_out_of_range(scen3, monkeypatch):
+    game = LiveGame(scen3)
+    game.append_round(*_synth_rounds(game, 1, seed=23)[0])
+    with pytest.raises(ValueError, match=r"\[0, 1\]"):
+        game.query("exact", prune=1.5)
+    # the env knob degrades with a warning (typo'd-knob contract) —
+    # pruning off, NOT an all-partners prune returning silent zeros
+    monkeypatch.setenv("MPLC_TPU_LIVE_PRUNE_TAU", "2.5")
+    with pytest.warns(UserWarning, match="outside"):
+        r = game.query("exact")
+    assert r.prune_tau == 0.0 and r.pruned_coalitions == 0
+
+
+def test_concurrent_live_queries_same_tenant_serialize():
+    """Two live-query jobs for ONE tenant on a two-worker pool: the
+    game-lock serialization must keep both quanta correct — same answer,
+    no clobbered progress hook, no double billing crash."""
+    from mplc_tpu.service import SweepService
+
+    svc = SweepService(workers=2)
+    try:
+        sc = _scenario_3p(seed=31)
+        game = svc.live_game(sc, tenant="t2w")
+        for deltas, w in _synth_rounds(game, 2, seed=15):
+            svc.append_round("t2w", deltas, w)
+        jobs = [svc.submit_live("t2w", method="exact") for _ in range(3)]
+        results = [np.asarray(j.result(timeout=120)) for j in jobs]
+        for r in results[1:]:
+            np.testing.assert_array_equal(results[0], r)
+        assert all(j.status == "completed" for j in jobs)
+        assert all(j.values for j in jobs)  # snapshotted under the lock
+        assert game.engine.progress is None  # hooks fully unwound
+    finally:
+        svc.shutdown(drain=False)
+
+
+def test_query_result_describe_roundtrips(scen3):
+    import json
+    game = LiveGame(scen3)
+    game.append_round(*_synth_rounds(game, 1, seed=14)[0])
+    r = game.query("exact")
+    doc = r.describe()
+    json.dumps(doc)
+    assert doc["method"] == "exact" and doc["rounds"] == 1
+    json.dumps(game.describe())
+
+
+# ---------------------------------------------------------------------------
+# report row schema
+# ---------------------------------------------------------------------------
+
+def test_live_report_row_schema():
+    recs = [
+        {"name": "live.append", "dur": 0.0, "attrs": {"tenant": "t"}},
+        {"name": "live.query", "dur": 0.42,
+         "attrs": {"tenant": "t", "method": "GTG-Shapley", "rounds": 7,
+                   "stamp": 3, "memo_hit": False, "evaluations": 40,
+                   "pruned": 12}},
+        {"name": "live.query", "dur": 0.001,
+         "attrs": {"tenant": "t", "method": "GTG-Shapley", "rounds": 7,
+                   "stamp": 3, "memo_hit": True, "evaluations": 0,
+                   "pruned": 0}},
+        {"name": "live.recover", "dur": 0.0,
+         "attrs": {"tenant": "t", "rounds": 7, "stamp": 3}},
+    ]
+    rep = sweep_report(recs)
+    lv = rep["live"]
+    assert lv["queries"] == 2 and lv["memo_hits"] == 1
+    assert lv["evaluations"] == 40 and lv["pruned_coalitions"] == 12
+    assert lv["rounds_appended"] == 1 and lv["recovered_games"] == 1
+    assert lv["rounds_resident"] == 7
+    assert lv["query_s"]["count"] == 1  # memo hits excluded from latency
+    assert lv["query_s"]["p50"] == pytest.approx(0.42)
+    txt = format_report(rep)
+    assert "live" in txt and "memo_hits=1" in txt
+    # record streams without live events keep the old schema exactly
+    assert "live" not in sweep_report(
+        [{"name": "engine.evaluate", "dur": 0.1,
+          "attrs": {"requested": 1, "missing": 1}}])

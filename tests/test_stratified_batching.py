@@ -1,0 +1,113 @@
+"""Stratified-MC speculative-lookahead batching (ISSUE 13 satellite).
+
+The stratified methods (SMCS / WR_SMC) keep their per-iteration adaptive
+allocation rule bit-identically — the sequential-oracle pins in
+tests/test_estimator_regression.py stay the authority on that — but now
+route multi-iteration batches through the CharacteristicEngine: each
+iteration's evaluate() call also carries the next `lookahead`
+iterations' draws, simulated on a CLONED rng under the current
+allocation. Contracts pinned here:
+
+  - speculation never changes the estimator: lookahead=4 scores/std are
+    bit-identical to lookahead=0 (v(S) is batch-invariant + the real rng
+    stream is untouched);
+  - speculation actually batches: with lookahead on, evaluate() calls
+    carry more than one iteration's pairs and most later iterations
+    arrive fully memoized (strictly fewer calls that still need device
+    work than the sequential schedule);
+  - the cloned-rng/cloned-pool plumbing leaves the live WR pools intact.
+"""
+
+import numpy as np
+
+from mplc_tpu.contrib.contributivity import Contributivity
+from mplc_tpu.contrib.sampling import WithoutReplacementRanks
+
+from test_contrib import fake_scenario
+
+
+def _saturating(phi):
+    return lambda s: min(1.0, 1.3 * sum(phi[i] for i in s))
+
+
+def _instrument(sc):
+    """Wrap the fake engine's evaluate() to record, per call, how many
+    UNIQUE requested keys still needed evaluation at call entry."""
+    eng = sc._charac_engine
+    calls = []
+    orig = eng.evaluate
+
+    def evaluate(subsets):
+        keys = [tuple(sorted(int(i) for i in s)) for s in subsets]
+        unique = list(dict.fromkeys(keys))
+        missing = [k for k in unique if k not in eng.charac_fct_values]
+        calls.append({"requested": len(unique), "missing": len(missing)})
+        return orig(subsets)
+
+    eng.evaluate = evaluate
+    return calls
+
+
+def _run(method, lookahead):
+    phi = [0.05, 0.15, 0.3, 0.5]
+    sc = fake_scenario(4, _saturating(phi))
+    calls = _instrument(sc)
+    c = Contributivity(sc)
+    if method == "SMCS":
+        c.Stratified_MC(sv_accuracy=0.05, alpha=0.95, lookahead=lookahead)
+    else:
+        c.without_replacment_SMC(sv_accuracy=0.05, alpha=0.95,
+                                 lookahead=lookahead)
+    return c, calls
+
+
+def test_smcs_lookahead_bit_identical():
+    seq, _ = _run("SMCS", 0)
+    spec, _ = _run("SMCS", 4)
+    np.testing.assert_array_equal(seq.contributivity_scores,
+                                  spec.contributivity_scores)
+    np.testing.assert_array_equal(seq.scores_std, spec.scores_std)
+
+
+def test_wr_smc_lookahead_bit_identical():
+    seq, _ = _run("WR_SMC", 0)
+    spec, _ = _run("WR_SMC", 4)
+    np.testing.assert_array_equal(seq.contributivity_scores,
+                                  spec.contributivity_scores)
+    np.testing.assert_array_equal(seq.scores_std, spec.scores_std)
+
+
+def _assert_batched(method):
+    n = 4
+    _, seq_calls = _run(method, 0)
+    _, spec_calls = _run(method, 4)
+    # sequential schedule: every call carries at most one iteration's 2N
+    # pairs; the speculative schedule packs multiple iterations per call
+    assert max(c["requested"] for c in seq_calls) <= 2 * n + 1
+    assert max(c["requested"] for c in spec_calls) > 2 * n + 1
+    # ... and converts later iterations into pure memo hits: strictly
+    # fewer calls still needing device work than the sequential path
+    seq_device = sum(1 for c in seq_calls if c["missing"])
+    spec_device = sum(1 for c in spec_calls if c["missing"])
+    assert spec_device < seq_device
+
+
+def test_smcs_lookahead_batches_iterations():
+    _assert_batched("SMCS")
+
+
+def test_wr_smc_lookahead_batches_iterations():
+    _assert_batched("WR_SMC")
+
+
+def test_wr_pool_clone_leaves_live_pool_untouched():
+    rng = np.random.default_rng(0)
+    pool = WithoutReplacementRanks(10)
+    pool.pop_random(rng)
+    clone = Contributivity._clone_pool(pool)
+    # draining the clone must not consume the live pool
+    while clone.total:
+        clone.pop_random(rng)
+    assert pool.total == 9
+    drawn = {pool.pop_random(rng) for _ in range(9)}
+    assert len(drawn) == 9  # still a without-replacement permutation
