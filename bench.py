@@ -226,6 +226,11 @@ _WORKLOAD_KNOBS = (
     # fenced batches run without overlap and pay an extra sync — a
     # different fence rate is a different measurement protocol
     "MPLC_TPU_DEVICE_FENCE_RATE",
+    # deterministic-reduce pins a different reduction order — v(S)
+    # itself changes, and the masked 2-D-family routing replaces slot
+    # execution; the numerics audit runs extra capture trainings at
+    # fence ordinals — both are different workloads entirely
+    "MPLC_TPU_DETERMINISTIC_REDUCE", "MPLC_TPU_NUMERICS_AUDIT",
     # donation reshapes the HBM-derived batch cap (bucket widths) and the
     # bank reshapes what a measured run pays in compile time
     "MPLC_TPU_DONATE_BUFFERS", "MPLC_TPU_PROGRAM_BANK",
@@ -373,6 +378,9 @@ def _spawn_cpu_fallback() -> int:
             "BENCH_TELEMETRY_FILE", "MPLC_TPU_TRACE_FILE",
             "MPLC_TPU_PROFILE_DIR", "MPLC_TPU_METRICS_PORT",
             "MPLC_TPU_METRICS_TOKEN",
+            # the child writing the parent's value ledger would corrupt
+            # the provenance artifact of the run that spawned it
+            "MPLC_TPU_NUMERICS_LEDGER",
             "MPLC_TPU_FLIGHT_RECORDER_DIR",
             "MPLC_TPU_FLIGHT_RECORDER_SIZE",
             "MPLC_TPU_CHROME_TRACE_FILE"):
@@ -700,12 +708,36 @@ def _write_telemetry(payload: dict, repo_root: str | None = None) -> None:
                 # ran in this process, e.g. a replayed measurement)
                 "warmup_skipped": _COMPILE_CACHE.get("warmup_skipped"),
             })
+        if _NUMERICS_SIDECAR.get("block"):
+            # the value-truth digest (obs/numerics.py ledger: engine
+            # fingerprint + per-subset v(S) bits) — what the bench_diff
+            # `numerics` gate compares across runs
+            payload.setdefault("numerics", _NUMERICS_SIDECAR["block"])
         write_report(path, payload)
         print(f"[bench] telemetry sidecar: {path}", file=sys.stderr,
               flush=True)
     except Exception as e:
         print(f"[bench] telemetry sidecar failed: {e}", file=sys.stderr,
               flush=True)
+
+
+# the last measured engine's ledger digest, attached to the sidecar by
+# _write_telemetry (None when MPLC_TPU_NUMERICS_LEDGER is unset)
+_NUMERICS_SIDECAR: dict = {"block": None}
+
+
+def _note_numerics(engine) -> None:
+    led = getattr(engine, "numerics_ledger", None)
+    if led is None:
+        return
+    _NUMERICS_SIDECAR["block"] = {
+        "engine_fingerprint": led.engine_fingerprint,
+        "reduction_mode": led.meta.get("reduction_mode"),
+        "topology": led.meta.get("topology"),
+        "part_shards": led.meta.get("part_shards"),
+        "entries": len(led.entries),
+        "values": led.values_bits(),
+    }
 
 
 def _degraded_run(rep: dict) -> bool:
@@ -786,6 +818,7 @@ def bench_exact_shapley(epochs, dtype):
     flops, fleet_peak, fleet_hbm = _compute_inputs(timed)
     _throughput_note(timed, elapsed, flops, fleet_peak)
     metric = f"exact_shapley_{dataset}_{n_partners}partners_{epochs}epochs_wallclock"
+    _note_numerics(timed)
     from mplc_tpu.obs.report import format_report, sweep_report
     rep = sweep_report(tele, flops_per_sample=flops, peak_flops=fleet_peak,
                        hbm_bytes_per_s=fleet_hbm)
@@ -1025,6 +1058,7 @@ def _bench_method(dataset_name, n_partners, method, epochs, dtype,
     _throughput_note(timed, elapsed, flops, fleet_peak)
     tag = method.lower().replace(" ", "_")
     metric = f"{tag}_{dataset_name}_{n_partners}partners_{epochs}epochs_wallclock"
+    _note_numerics(timed)
     from mplc_tpu.obs.report import format_report, sweep_report
     rep = sweep_report(tele, flops_per_sample=flops, peak_flops=fleet_peak,
                        hbm_bytes_per_s=fleet_hbm)

@@ -305,6 +305,81 @@ SERVICE_WORKERS_ENV = "MPLC_TPU_SERVICE_WORKERS"
 SERVICE_PRIORITY_DEFAULT_ENV = "MPLC_TPU_SERVICE_PRIORITY_DEFAULT"
 SERVICE_SHED_P99_ENV = "MPLC_TPU_SERVICE_SHED_P99_SEC"
 
+# Numeric-truth plane (mplc_tpu/obs/numerics.py):
+#   MPLC_TPU_DETERMINISTIC_REDUCE  =1 replaces every aggregation's
+#                                  order-sensitive `sum`/`psum` pair with
+#                                  a strict left-to-right fold in GLOBAL
+#                                  partner order (sharded runs all-gather
+#                                  the weighted terms over `part` first),
+#                                  so the 2-D [coal x part] partner-
+#                                  sharded path is BIT-IDENTICAL to the
+#                                  unsharded reference. Changes v(S)
+#                                  itself (a different — pinned —
+#                                  reduction order), so it is part of the
+#                                  coalition-cache fingerprint and a
+#                                  workload knob. Resolved into TrainConfig
+#                                  at construction time.
+#   MPLC_TPU_NUMERICS_AUDIT        =1 turns on the per-device reduction
+#                                  audit: at fence ordinals the engine
+#                                  captures one audited coalition's
+#                                  per-round per-partner aggregation terms
+#                                  through a SEPARATE instrumented run
+#                                  (the dispatched programs are never
+#                                  touched — v(S) is bit-identical audit
+#                                  on vs off), replays the sharded
+#                                  (per-device partial + cross-shard
+#                                  combine) and reference fold orders on
+#                                  the host, and localizes the FIRST
+#                                  divergent reduction step/leaf. A
+#                                  detected divergence emits a
+#                                  numerics.drift event and a flight-
+#                                  recorder postmortem.
+#   MPLC_TPU_NUMERICS_LEDGER       path of the value-provenance ledger
+#                                  (JSON): every harvested v(S) is
+#                                  recorded with its exact float bits, a
+#                                  content hash and float-path metadata
+#                                  (topology, device count, reduction
+#                                  mode, slot width, cap rungs) keyed by
+#                                  (subset bitmask, engine fingerprint) —
+#                                  scripts/drift_diff.py diffs two
+#                                  ledgers into per-subset ulp-distance
+#                                  histograms and a ranking Kendall-tau.
+DETERMINISTIC_REDUCE_ENV = "MPLC_TPU_DETERMINISTIC_REDUCE"
+NUMERICS_AUDIT_ENV = "MPLC_TPU_NUMERICS_AUDIT"
+NUMERICS_LEDGER_ENV = "MPLC_TPU_NUMERICS_LEDGER"
+
+
+_barrier_degradation_warned = False
+
+
+def deterministic_reduce_enabled() -> bool:
+    """MPLC_TPU_DETERMINISTIC_REDUCE=1 (default off). Read at
+    TrainConfig-construction time and frozen into the config, so the
+    reduction order a trainer compiled with can never drift from the
+    one its cache fingerprint names.
+
+    If the deterministic mode is requested but the `fusion_fence`
+    batching rule could not be installed (a toolchain moved the
+    optimization_barrier primitive), the bit-identity contract is
+    weakened — warn LOUDLY once rather than let a run report
+    reduction_mode=deterministic while the fence silently no-ops."""
+    on = _os.environ.get(DETERMINISTIC_REDUCE_ENV, "") == "1"
+    if on:
+        global _barrier_degradation_warned
+        from .ops.aggregation import _BARRIER_OK
+        if not _BARRIER_OK and not _barrier_degradation_warned:
+            _barrier_degradation_warned = True
+            import warnings
+            warnings.warn(
+                f"{DETERMINISTIC_REDUCE_ENV}=1 but the optimization_"
+                "barrier batching rule could not be installed on this "
+                "toolchain — fusion_fence is a no-op and cross-topology "
+                "bit-identity is NOT guaranteed (the ordered fold still "
+                "applies). Verify with the numerics ledger/drift_diff "
+                "before trusting cross-topology equality.", stacklevel=2)
+    return on
+
+
 # Device-time accounting (mplc_tpu/obs/devcost.py):
 #   MPLC_TPU_DEVICE_FENCE_RATE     fraction of device batches that run
 #                                  FENCED: the engine drains any
@@ -440,6 +515,16 @@ ENV_KNOBS = {
     # measured wall-clock (never v(S)), so a cached TPU number from a
     # different fence rate is a different measurement protocol
     "MPLC_TPU_DEVICE_FENCE_RATE": "workload",
+    # deterministic-reduce changes v(S) ITSELF (a pinned reduction order
+    # is a different — bit-stable — game trajectory), and the audit
+    # drains overlap + runs extra capture passes at fence ordinals, so
+    # both reshape what a measured run computes or pays
+    "MPLC_TPU_DETERMINISTIC_REDUCE": "workload",
+    "MPLC_TPU_NUMERICS_AUDIT": "workload",
+    # the ledger is pure observability output: recording harvested value
+    # bits changes nothing the run computes or pays, but the CPU-fallback
+    # child must not write over the parent's ledger file
+    "MPLC_TPU_NUMERICS_LEDGER": "sidecar",
     "MPLC_TPU_PROFILE_DIR": "sidecar",
     "MPLC_TPU_METRICS_TOKEN": "sidecar",
     "MPLC_TPU_TRACE_FILE": "sidecar",

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from .. import constants, faults
 from ..obs import devcost
 from ..obs import metrics as obs_metrics
+from ..obs import numerics as obs_numerics
 from ..obs import trace as obs_trace
 from ..data.partition import StackedPartners, stack_eval_set
 from ..mpl.engine import (EvalSet, MplTrainer, TrainConfig,
@@ -285,10 +286,18 @@ class Batched2DTrainerPipeline(BatchedTrainerPipeline):
         donate = (0,) if buffer_donation_enabled() else ()
         self._fin_donates = bool(donate)
 
+        hoist = trainer._det_hoist_streams()
+
         def run_fn(state, stacked, val, masks, rngs, n_epochs):
             return jax.vmap(trainer.epoch_chunk,
                             in_axes=(0, None, None, 0, 0, None))(
                 state, stacked, val, masks, rngs, n_epochs)
+
+        def run_fn_streams(state, stacked, val, masks, rngs, streams,
+                           n_epochs):
+            return jax.vmap(trainer._epoch_chunk_streams,
+                            in_axes=(0, None, None, 0, 0, 0, None))(
+                state, stacked, val, masks, rngs, streams, n_epochs)
 
         # keyed by n_epochs; exposed as an attribute so the compiler-level
         # sharding tests can .lower() the exact jitted program this
@@ -297,10 +306,33 @@ class Batched2DTrainerPipeline(BatchedTrainerPipeline):
 
         def run(state, stacked, val, masks, rngs, n_epochs):
             if n_epochs not in run_cache:
-                run_cache[n_epochs] = jax.jit(shard_map_norep(
-                    partial(run_fn, n_epochs=n_epochs), mesh=mesh,
-                    in_specs=(st_b, sp, P(), P("coal", "part"), P("coal")),
-                    out_specs=st_b), donate_argnums=donate)
+                if hoist:
+                    # deterministic-reduce: the hoisted stream stacks ride
+                    # in as data, partner-sliced over `part` like the
+                    # stacked tensors (obs/numerics.py — in-program
+                    # stream generation next to the aggregation
+                    # collective is what breaks cross-topology
+                    # bit-identity)
+                    stream_specs = (P("coal", None, "part", None),
+                                    P("coal", None, None, "part", None))
+                    run_cache[n_epochs] = jax.jit(shard_map_norep(
+                        partial(run_fn_streams, n_epochs=n_epochs),
+                        mesh=mesh,
+                        in_specs=(st_b, sp, P(), P("coal", "part"),
+                                  P("coal"), stream_specs),
+                        out_specs=st_b), donate_argnums=donate)
+                else:
+                    run_cache[n_epochs] = jax.jit(shard_map_norep(
+                        partial(run_fn, n_epochs=n_epochs), mesh=mesh,
+                        in_specs=(st_b, sp, P(), P("coal", "part"),
+                                  P("coal")),
+                        out_specs=st_b), donate_argnums=donate)
+            if hoist:
+                streams = trainer.jit_gen_streams(
+                    rngs, n_epochs, stacked.mask, batched=True,
+                    start_epoch=state.epoch)
+                return run_cache[n_epochs](state, stacked, val, masks,
+                                           rngs, streams)
             return run_cache[n_epochs](state, stacked, val, masks, rngs)
 
         self._run = run
@@ -325,6 +357,11 @@ class CharacteristicEngine:
     # that bypass __init__ run unfenced and unmetered
     device_meter = None
     _fence_interval = 0
+    # numeric-truth plane defaults (obs/numerics.py): doubles that bypass
+    # __init__ run unledgered and unaudited
+    numerics_ledger = None
+    _numerics_audit = False
+    _ledger_ctx: dict = {}
     # set when a legacy (pre-checksum) cache was loaded: the next
     # save_cache to that file rewrites it in the integrity format
     _cache_needs_upgrade = False
@@ -522,13 +559,28 @@ class CharacteristicEngine:
         # write the effective value back so to_dataframe/results.csv record
         # the mode actually run, even under the env override
         scenario.partner_shards = part_shards
-        if part_shards > 1:
+        # Deterministic-reduce (obs/numerics.py): the masked fedavg/lflip
+        # path ALWAYS runs through the [coal x part] shard_map pipeline —
+        # with part_shards=1 when unsharded — because the bit-identity
+        # contract holds WITHIN the shard_map program family (the audit's
+        # localization: a plain-jit embedding of the same pass rounds
+        # differently than its shard_map twin). part=1 is the unsharded
+        # reference: the whole partner axis is resident per device and the
+        # gather collective over the singleton axis moves nothing.
+        det2d = (bool(multi_cfg.deterministic_reduce)
+                 and multi_cfg.approach in ("fedavg", "lflip")
+                 and multi_cfg.partner_drop_epochs is None
+                 and multi_cfg.partner_straggler_delays is None)
+        if part_shards > 1 or det2d:
             if self.seed_ensemble > 1:
                 raise ValueError(
                     "seed-ensemble sweeps (MPLC_TPU_SEED_ENSEMBLE > 1) are "
-                    "not supported in the 2-D partner-sharded mode")
+                    "not supported in the 2-D partner-sharded mode (nor "
+                    "under MPLC_TPU_DETERMINISTIC_REDUCE, which routes "
+                    "through the same pipeline)")
             n_dev = len(jax.devices())
-            if multi_cfg.approach not in ("fedavg", "lflip"):
+            if part_shards > 1 and multi_cfg.approach not in ("fedavg",
+                                                              "lflip"):
                 raise ValueError(
                     "MPLC_TPU_PARTNER_SHARDS requires a partner-parallel "
                     f"approach (fedavg/lflip), got {multi_cfg.approach!r}")
@@ -615,12 +667,53 @@ class CharacteristicEngine:
         self._fence_interval = devcost.fence_interval()
         self.device_meter = devcost.DeviceMeter(self._fence_interval)
 
+        # Numeric-truth plane (obs/numerics.py): the opt-in value-
+        # provenance ledger (MPLC_TPU_NUMERICS_LEDGER names the output
+        # file; one ledger per engine keyed by the cache fingerprint) and
+        # the fence-sampled per-device reduction audit
+        # (MPLC_TPU_NUMERICS_AUDIT=1 — runs a SEPARATE instrumented
+        # capture per audited coalition, so audit-on vs audit-off v(S)
+        # is bit-identical; equality-tested).
+        self._numerics_audit = obs_numerics.audit_enabled()
+        self._audited_subsets: set = set()
+        self.numerics_audits: list = []
+        self._ledger_ctx = {}
+        _ledger_path = obs_numerics.ledger_path_from_env()
+        if _ledger_path:
+            import hashlib as _hashlib
+            import json as _json
+            fp_digest = _hashlib.sha256(
+                _json.dumps(self._fingerprint(),
+                            sort_keys=True).encode()).hexdigest()[:16]
+            self.numerics_ledger = obs_numerics.ValueLedger(
+                fp_digest,
+                meta={
+                    "topology": "2d" if self._pipe2d is not None else "1d",
+                    "part_shards": (self._pipe2d.part_shards
+                                    if self._pipe2d is not None else 1),
+                    "n_devices": len(jax.devices()),
+                    "reduction_mode": ("deterministic"
+                                       if multi_cfg.deterministic_reduce
+                                       else "default"),
+                    "slot_bucketing": scenario.slot_bucketing,
+                },
+                path=_ledger_path)
+        else:
+            self.numerics_ledger = None
+
         self._sharding = coalition_sharding()
 
         # Program bank (contrib/bank.py): AOT-compiled slot programs with
         # compile/execute overlap. None when disabled — every program then
         # compiles inline at first dispatch, the pre-bank behavior.
-        self.program_bank = ProgramBank(self) if bank_enabled() else None
+        # Deterministic-reduce is a correctness mode and runs bank-less:
+        # its hoisted-stream trainers dispatch through wrapper callables
+        # the bank cannot `.lower()`, and its masked path runs the
+        # (unbanked) 2-D-family pipeline anyway.
+        self.program_bank = (ProgramBank(self)
+                             if bank_enabled()
+                             and not multi_cfg.deterministic_reduce
+                             else None)
 
     # ------------------------------------------------------------------
 
@@ -1405,6 +1498,11 @@ class CharacteristicEngine:
         batch_epochs = 0
         batch_samples = 0
         ensemble = bool(meta.get("ensemble"))
+        # numeric-truth context for the ledger notes `_store` writes for
+        # this batch's values (restored after the loop: stores outside a
+        # batch must not inherit the last batch's float path)
+        self._ledger_ctx = {"slot_count": slot_count,
+                            "degraded": meta.get("degraded")}
         for item, acc, ep in zip(group, accs[:len(group)],
                                  epochs[:len(group)]):
             if ensemble:
@@ -1429,9 +1527,24 @@ class CharacteristicEngine:
             batch_samples += int(ep) * int(
                 sum(int(per_partner[i])
                     for i in self._effective_subset(s)))
+        self._ledger_ctx = {}
         self.epochs_trained += batch_epochs
         self.samples_trained += batch_samples
         obs_metrics.counter("engine.batches").inc()
+        if (self._numerics_audit and meta.get("device_sec") is not None
+                and not ensemble and group
+                and len(self.numerics_audits) < 4):
+            # fence-sampled reduction audit (obs/numerics.py): audit the
+            # fenced batch's first coalition through a separate capture
+            # run — never the dispatched programs, so v(S) is untouched.
+            # Bounded to 4 audits per engine: each costs one training.
+            s0 = group[0]
+            key = tuple(s0)
+            if key not in self._audited_subsets:
+                self._audited_subsets.add(key)
+                res = obs_numerics.audit_coalition(self, s0)
+                if res is not None:
+                    self.numerics_audits.append(res)
         # partner passes executed on device for this batch, INCLUDING the
         # padded/inactive slot or mask rows (what the hardware ran, not just
         # the useful share): epochs x minibatches x passes-per-minibatch,
@@ -1632,6 +1745,18 @@ class CharacteristicEngine:
     def _store(self, subset: tuple, value: float) -> None:
         self.charac_fct_values[subset] = value
         self.first_charac_fct_calls_count += 1
+        if self.numerics_ledger is not None:
+            # value provenance: the exact harvested bits + the float path
+            # that produced them (batch slot width, OOM rungs taken, CPU
+            # degradation) — `_ledger_ctx` is stamped per batch by
+            # _record_group; stores outside a batch (null coalitions,
+            # journal-recovered seeds) carry the defaults
+            ctx = self._ledger_ctx
+            self.numerics_ledger.record(
+                subset, value, source="exact",
+                slot_width=ctx.get("slot_count"),
+                cap_halvings=self._cap_halvings,
+                degraded=bool(ctx.get("degraded")))
         # marginal-increment bookkeeping (reference contributivity.py:116-134)
         sset = set(subset)
         for i in range(self.partners_count):
@@ -1724,6 +1849,10 @@ class CharacteristicEngine:
                              and self._pipe2d is None else None)
                 obs_metrics.sample_device_memory()
                 obs_trace.event("engine.hbm", **self._hbm_attrs(slot_hint))
+            if missing and self.numerics_ledger is not None:
+                # persist the value-provenance ledger once per evaluate()
+                # call that did device work (atomic, never raises)
+                self.numerics_ledger.save()
         if self._cache_needs_upgrade and self.autosave_path is not None:
             # legacy-cache convergence: even a fully-memoized sweep (no
             # batch ran, so no per-batch autosave fired) rewrites the
@@ -1831,6 +1960,10 @@ class CharacteristicEngine:
             # the wide-step deviation changes every trajectory at mult > 1:
             # a cache built under one mult describes a different game
             "step_width_mult": cfg.step_width_mult,
+            # deterministic-reduce pins a DIFFERENT (fixed) reduction
+            # order, so its v(S) trajectories are a different game from
+            # the default order-sensitive reduction's
+            "deterministic_reduce": bool(cfg.deterministic_reduce),
             # a partner-fault plan changes v(S) itself (dropped/straggling
             # partners train differently), so any two distinct plans
             # describe different games; the ensemble width changes what a
@@ -1970,6 +2103,9 @@ class CharacteristicEngine:
         theirs.setdefault("step_width_mult", 1)
         theirs.setdefault("partner_fault_plan", "")
         theirs.setdefault("seed_ensemble", 1)
+        # pre-numerics caches ran the only reduction there was — the
+        # default order-sensitive one
+        theirs.setdefault("deterministic_reduce", False)
         ours = self._fingerprint()
         if "partners_count" in theirs and \
                 theirs["partners_count"] != ours["partners_count"]:

@@ -426,6 +426,15 @@ class ReconstructionEvaluator:
                 continue
             for s, acc in zip(group, accs[:len(group)]):
                 self.values[s] = float(acc)
+                if eng.numerics_ledger is not None:
+                    # value provenance for reconstructed v(S): same ledger
+                    # as the exact memo, tagged by source so a drift diff
+                    # can't silently mix reconstruction against retraining
+                    eng.numerics_ledger.record(
+                        s, float(acc), source="reconstruction",
+                        slot_width=slot_count,
+                        cap_halvings=eng._cap_halvings,
+                        degraded=bool(meta.get("degraded")))
             self.reconstructions += len(group)
             obs_metrics.counter("engine.batches").inc()
             obs_metrics.counter("engine.reconstructions").inc(len(group))

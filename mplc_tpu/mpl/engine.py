@@ -58,7 +58,8 @@ from .. import constants
 from ..models.core import Model
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
-from ..ops.aggregation import aggregate, aggregation_weights, broadcast
+from ..ops.aggregation import (aggregate, aggregation_weights, broadcast,
+                               fusion_fence)
 from ..ops.metrics import masked_loss_and_metrics
 
 APPROACH_NAMES = ("fedavg", "seq-pure", "seq-with-final-agg", "seqavg", "lflip", "single")
@@ -195,8 +196,23 @@ class TrainConfig:
     # execution has nothing to save); off by default, and the off build
     # is byte-identical to the pre-recording trainer.
     record_updates: bool = False
+    # Deterministic-reduction mode (MPLC_TPU_DETERMINISTIC_REDUCE,
+    # obs/numerics.py): every aggregation reduces its weighted per-partner
+    # terms by a strict left-to-right fold in GLOBAL partner order
+    # (ops/aggregation.py `ordered_fold`) instead of the order-sensitive
+    # `sum`/`psum` pair — under partner-axis sharding the terms are
+    # all-gathered over `part` first, so the 2-D [coal x part] path is
+    # BIT-IDENTICAL to the unsharded reference. Off (the default) keeps
+    # the historical reduction and is byte-identical to the pre-knob
+    # build. None = resolve from the env at construction time (the
+    # resolved value is part of the frozen config, the trainer-registry
+    # key and the engine cache fingerprint).
+    deterministic_reduce: bool | None = None
 
     def __post_init__(self):
+        if self.deterministic_reduce is None:
+            object.__setattr__(self, "deterministic_reduce",
+                               constants.deterministic_reduce_enabled())
         if self.approach not in APPROACH_NAMES:
             raise KeyError(
                 f"Multi-partner learning approach '{self.approach}' is not a valid "
@@ -335,14 +351,106 @@ class MplTrainer:
         loop)."""
         return (0,) if buffer_donation_enabled() else ()
 
+    # ------------------------------------------------------------------
+    # deterministic-reduce stream hoisting: under the numeric-truth
+    # plane's MPLC_TPU_DETERMINISTIC_REDUCE mode, the per-epoch partner
+    # permutations and per-partner pass keys are generated by a SEPARATE
+    # jitted dispatch and passed into the training program as DATA. The
+    # values are identical to the in-program generation (same fold_in
+    # formulas), but the numerics audit (obs/numerics.py) localized the
+    # residual 2-D drift to exactly this: a program that GENERATES its
+    # threefry streams next to a collective compiles the training pass
+    # differently per topology, while the same program consuming the
+    # streams as inputs is bit-stable (tests/test_numerics.py).
+    # ------------------------------------------------------------------
+
+    def _det_hoist_streams(self) -> bool:
+        """Stream hoisting applies to the deterministic masked
+        fedavg/lflip path with early stopping off — the coalition-sweep
+        configuration, where `state.epoch == i` throughout a chunk so the
+        per-epoch rng folds are concrete. ES-on deterministic runs keep
+        in-program generation (same fold rule; no cross-topology claim)."""
+        cfg = self.cfg
+        return (bool(cfg.deterministic_reduce)
+                and not cfg.is_early_stopping
+                and cfg.approach in ("fedavg", "lflip")
+                and cfg.slot_count is None)
+
+    def gen_epoch_streams(self, rng: jax.Array, mask_pn, start_epoch,
+                          n_epochs: int):
+        """([E, P, Nmax] epoch permutations, [E, MB, P, 2] per-partner
+        pass keys) for one coalition's chunk — the exact streams
+        `_fedavg_epoch` would generate in-program for chunk positions
+        0..E-1: the chunk body folds the rng by POSITION i, then
+        run_epoch folds by `state.epoch` = start_epoch + i. Carrying
+        `start_epoch` (a traced scalar) keeps resumed chunks — e.g.
+        PVRL's repeated n_epochs=1 calls on a live state — on the same
+        stream rule as the in-program generation, so E one-epoch chunks
+        and one E-epoch chunk train identically."""
+        P = mask_pn.shape[0]
+        perms, keys = [], []
+        for i in range(n_epochs):
+            re = jax.random.fold_in(jax.random.fold_in(rng, i),
+                                    start_epoch + i)
+            perms.append(self._epoch_perms(jax.random.fold_in(re, 0),
+                                           mask_pn))
+            mbs = []
+            for mb_i in range(self.cfg.minibatch_count):
+                rng_mb = jax.random.fold_in(jax.random.fold_in(re, 1), mb_i)
+                mbs.append(jax.vmap(
+                    lambda p, r=rng_mb: jax.random.fold_in(r, p))(
+                        jnp.arange(P, dtype=jnp.int32)))
+            keys.append(jnp.stack(mbs))
+        return jnp.stack(perms), jnp.stack(keys)
+
+    def jit_gen_streams(self, rng, n_epochs: int, mask_pn, batched: bool,
+                        start_epoch=None):
+        """Dispatch the stream generator as its OWN compiled program
+        (cached per (n_epochs, batched)); `batched` vmaps over a [B, 2]
+        rng batch (and the matching [B] start-epoch vector) for the
+        coalition-batched pipelines. `start_epoch` defaults to zero(s) —
+        a fresh chunk."""
+        key = ("gen_streams", n_epochs, batched)
+        if key not in self._jits:
+            fn = partial(self.gen_epoch_streams, n_epochs=n_epochs)
+            if batched:
+                fn = jax.vmap(fn, in_axes=(0, None, 0))
+            # no-donation by policy: inputs are the live rng batch and
+            # the stacked mask, both reused by the chunk call right after
+            self._jits[key] = _CompileTimedFn(
+                jax.jit(fn), "gen_streams")
+        if start_epoch is None:
+            start_epoch = (jnp.zeros((rng.shape[0],), jnp.int32)
+                           if batched else jnp.zeros((), jnp.int32))
+        return self._jits[key](rng, mask_pn, start_epoch)
+
+    def _epoch_chunk_streams(self, state, stacked, val, coal_mask, rng,
+                             streams_all, n_epochs: int):
+        return self.epoch_chunk(state, stacked, val, coal_mask, rng,
+                                n_epochs, streams_all=streams_all)
+
     @property
     def jit_epoch_chunk(self):
         don = buffer_donation_enabled()
         key = ("epoch_chunk", don)
         if key not in self._jits:
-            self._jits[key] = _CompileTimedFn(jax.jit(
-                self.epoch_chunk, static_argnames=("n_epochs",),
-                donate_argnums=self._donate_state()), "epoch_chunk")
+            if self._det_hoist_streams():
+                inner = _CompileTimedFn(jax.jit(
+                    self._epoch_chunk_streams,
+                    static_argnames=("n_epochs",),
+                    donate_argnums=self._donate_state()), "epoch_chunk")
+
+                def hoisted(state, stacked, val, coal_mask, rng, n_epochs):
+                    streams = self.jit_gen_streams(
+                        rng, n_epochs, stacked.mask, batched=False,
+                        start_epoch=state.epoch)
+                    return inner(state, stacked, val, coal_mask, rng,
+                                 streams, n_epochs=n_epochs)
+                self._jits[key] = hoisted
+            else:
+                self._jits[key] = _CompileTimedFn(jax.jit(
+                    self.epoch_chunk, static_argnames=("n_epochs",),
+                    donate_argnums=self._donate_state()), "epoch_chunk")
         return self._jits[key]
 
     @property
@@ -380,10 +488,28 @@ class MplTrainer:
         don = buffer_donation_enabled()
         key = ("brun", don)
         if key not in self._jits:
-            self._jits[key] = _CompileTimedFn(jax.jit(
-                jax.vmap(self.epoch_chunk, in_axes=(0, None, None, 0, 0, None)),
-                static_argnames=("n_epochs",),
-                donate_argnums=self._donate_state()), "batched_epoch_chunk")
+            if self._det_hoist_streams():
+                inner = _CompileTimedFn(jax.jit(
+                    jax.vmap(self._epoch_chunk_streams,
+                             in_axes=(0, None, None, 0, 0, 0, None)),
+                    static_argnames=("n_epochs",),
+                    donate_argnums=self._donate_state()),
+                    "batched_epoch_chunk")
+
+                def hoisted(states, stacked, val, masks, rngs, n_epochs):
+                    streams = self.jit_gen_streams(
+                        rngs, n_epochs, stacked.mask, batched=True,
+                        start_epoch=states.epoch)
+                    return inner(states, stacked, val, masks, rngs,
+                                 streams, n_epochs)
+                self._jits[key] = hoisted
+            else:
+                self._jits[key] = _CompileTimedFn(jax.jit(
+                    jax.vmap(self.epoch_chunk,
+                             in_axes=(0, None, None, 0, 0, None)),
+                    static_argnames=("n_epochs",),
+                    donate_argnums=self._donate_state()),
+                    "batched_epoch_chunk")
         return self._jits[key]
 
     @property
@@ -658,6 +784,29 @@ class MplTrainer:
         """metrics: [4, P] (loss, acc, val_loss, val_acc) for this round."""
         return partner_h.at[:, :, e, mb_i].set(metrics)
 
+    def _det_isolated_vmap(self, fn, args, in_axes):
+        """vmap `fn` over the partner/slot axis; under deterministic-reduce
+        the batched call is fenced (`fusion_fence`) on every input and
+        output edge, so XLA compiles the per-partner pass as the same
+        isolated computation in every program that embeds it — the
+        unsharded [P] epoch, each shard_map-local [P/shards] epoch, and
+        the [K]-slot epoch. Without the fence, cross-boundary fusion
+        (e.g. an FMA forming between a surrounding multiply and an
+        in-pass dot, or a dot tiled differently against its consumers)
+        rounds a few lanes differently per embedding — one root of the
+        2-D drift beside the psum order, localized by the reduction
+        audit (obs/numerics.py) — and adam's sqrt(v)-normalized updates
+        amplify those last-ulp differences chaotically. The other root
+        is handled by the callers: the pass's train-loss/acc aux outputs
+        are DROPPED under deterministic-reduce (the partner history gets
+        NaN), because keeping the loss reductions live alongside the
+        backward makes XLA tile the shared forward width-dependently.
+        Default mode is byte-identical to the historical plain vmap."""
+        if not self.cfg.deterministic_reduce:
+            return jax.vmap(fn, in_axes=in_axes)(*args)
+        args = fusion_fence(args)
+        return fusion_fence(jax.vmap(fn, in_axes=in_axes)(*args))
+
     # ------------------------------------------------------------------
     # partner-level faults (dropout / straggler) — helpers shared by the
     # masked and slot fedavg epochs and the single trainer. All three are
@@ -703,7 +852,7 @@ class MplTrainer:
             stale, params)
 
     def _fedavg_epoch(self, state: TrainState, stacked, val: EvalSet,
-                      coal_mask, rng) -> TrainState:
+                      coal_mask, rng, streams=None) -> TrainState:
         cfg = self.cfg
         P = stacked.x.shape[0]
         e = state.epoch
@@ -711,8 +860,25 @@ class MplTrainer:
             shard_offset = jax.lax.axis_index(cfg.partner_axis) * P
         else:
             shard_offset = 0
-        perms = self._epoch_perms(jax.random.fold_in(rng, 0), stacked.mask,
-                                  offset=shard_offset)
+        if streams is not None:
+            # hoisted deterministic streams ([P(, local), Nmax] perms +
+            # [MB, P, 2] pass keys), generated by a separate dispatch and
+            # entering this program as DATA — under partner sharding the
+            # in_specs sliced them to the local partner rows already, so
+            # no shard offset applies. The numerics audit localized the
+            # residual 2-D drift to in-program generation: a program that
+            # derives its threefry streams next to a collective compiles
+            # the training pass differently per topology.
+            perms, mb_keys = streams
+        else:
+            mb_keys = None
+            perms = self._epoch_perms(jax.random.fold_in(rng, 0),
+                                      stacked.mask, offset=shard_offset)
+            if cfg.deterministic_reduce:
+                # fence the generated permutations (and below, the
+                # per-partner pass rngs) — second-best to hoisting, for
+                # the ES-on deterministic path that cannot hoist
+                perms = fusion_fence(perms)
         lflip = cfg.approach == "lflip"
         n_max = stacked.x.shape[1]
         mb_cap = max(n_max // cfg.minibatch_count, 1)
@@ -738,40 +904,75 @@ class MplTrainer:
             vl_h = vl_h.at[e, mb_i].set(vl)
             va_h = va_h.at[e, mb_i].set(va)
 
-            rng_mb = jax.random.fold_in(jax.random.fold_in(rng, 1), mb_i)
-            # Per-partner rng keyed by GLOBAL partner index, so a
-            # partner-sharded run trains identically to the unsharded one.
-            p_rngs = jax.vmap(lambda i: jax.random.fold_in(rng_mb, i))(
-                jnp.arange(P, dtype=jnp.int32) + shard_offset)
+            if mb_keys is not None:
+                p_rngs = mb_keys[mb_i]
+            else:
+                rng_mb = jax.random.fold_in(jax.random.fold_in(rng, 1), mb_i)
+                # Per-partner rng keyed by GLOBAL partner index, so a
+                # partner-sharded run trains identically to the unsharded
+                # one.
+                p_rngs = jax.vmap(lambda i: jax.random.fold_in(rng_mb, i))(
+                    jnp.arange(P, dtype=jnp.int32) + shard_offset)
+                if cfg.deterministic_reduce:
+                    p_rngs = fusion_fence(p_rngs)
 
+            # deterministic-reduce: the pass's train-loss/acc aux outputs
+            # are dropped (the partner history records NaN for them) —
+            # with the loss reductions live next to the backward, XLA
+            # tiles the shared forward differently per batch width, and
+            # the [P]-wide, [P/shards]-wide and [K]-slot embeddings of
+            # the SAME pass round differently (see _det_isolated_vmap)
+            det = cfg.deterministic_reduce
             if lflip:
-                def one(theta_p, x_p, y_p, perm_p, size_p, act, r):
+                def one(start, theta_p, x_p, y_p, perm_p, size_p, act, r):
                     new_theta, y_flip, w_idx, _ = self._lflip_flip(
-                        params, theta_p, x_p, y_p, perm_p, size_p, mb_i, mb_cap, r)
+                        start, theta_p, x_p, y_p, perm_p, size_p, mb_i, mb_cap, r)
                     new_theta = jnp.where(act > 0, new_theta, theta_p)
                     p, _, ls, ac = self._partner_pass(
-                        params, x_p, y_p, perm_p, size_p, act, mb_i,
+                        start, x_p, y_p, perm_p, size_p, act, mb_i,
                         jax.random.fold_in(r, 7), y_override=y_flip, window_idx=w_idx)
+                    if det:
+                        return p, new_theta
                     return p, new_theta, ls, ac
-                new_params, theta, losses, accs = jax.vmap(one)(
-                    theta, stacked.x, stacked.y, perms, stacked.sizes, coal_mask, p_rngs)
+                out = self._det_isolated_vmap(
+                    one, (params, theta, stacked.x, stacked.y, perms,
+                          stacked.sizes, coal_mask, p_rngs),
+                    in_axes=(None, 0, 0, 0, 0, 0, 0, 0))
+                if det:
+                    new_params, theta = out
+                    losses = accs = jnp.full((P,), jnp.nan)
+                else:
+                    new_params, theta, losses, accs = out
             elif stragglers:
                 starts = self._straggler_starts(params, stale)
 
                 def one(start_p, x_p, y_p, perm_p, size_p, act, r):
                     p, _, ls, ac = self._partner_pass(
                         start_p, x_p, y_p, perm_p, size_p, act, mb_i, r)
-                    return p, ls, ac
-                new_params, losses, accs = jax.vmap(one)(
-                    starts, stacked.x, stacked.y, perms, stacked.sizes,
-                    act_mask, p_rngs)
+                    return (p,) if det else (p, ls, ac)
+                out = self._det_isolated_vmap(
+                    one, (starts, stacked.x, stacked.y, perms, stacked.sizes,
+                          act_mask, p_rngs),
+                    in_axes=(0, 0, 0, 0, 0, 0, 0))
+                if det:
+                    (new_params,) = out
+                    losses = accs = jnp.full((P,), jnp.nan)
+                else:
+                    new_params, losses, accs = out
             else:
-                def one(x_p, y_p, perm_p, size_p, act, r):
+                def one(start, x_p, y_p, perm_p, size_p, act, r):
                     p, _, ls, ac = self._partner_pass(
-                        params, x_p, y_p, perm_p, size_p, act, mb_i, r)
-                    return p, ls, ac
-                new_params, losses, accs = jax.vmap(one)(
-                    stacked.x, stacked.y, perms, stacked.sizes, act_mask, p_rngs)
+                        start, x_p, y_p, perm_p, size_p, act, mb_i, r)
+                    return (p,) if det else (p, ls, ac)
+                out = self._det_isolated_vmap(
+                    one, (params, stacked.x, stacked.y, perms, stacked.sizes,
+                          act_mask, p_rngs),
+                    in_axes=(None, 0, 0, 0, 0, 0, 0))
+                if det:
+                    (new_params,) = out
+                    losses = accs = jnp.full((P,), jnp.nan)
+                else:
+                    new_params, losses, accs = out
 
             need_pval = cfg.record_partner_val or cfg.aggregator == "local-score"
             if need_pval:
@@ -784,7 +985,8 @@ class MplTrainer:
 
             w = aggregation_weights(cfg.aggregator, act_mask,
                                     stacked.sizes, jnp.nan_to_num(pva),
-                                    axis_name=cfg.partner_axis)
+                                    axis_name=cfg.partner_axis,
+                                    deterministic=cfg.deterministic_reduce)
             if recording:
                 # the round's recorded row: per-partner delta from the
                 # round-start global params (inactive/dropped partners
@@ -796,7 +998,8 @@ class MplTrainer:
                     lambda h, loc, g: h.at[r_idx].set(loc - g),
                     upd_h, new_params, params)
                 w_h = w_h.at[r_idx].set(w)
-            agg = aggregate(new_params, w, axis_name=cfg.partner_axis)
+            agg = aggregate(new_params, w, axis_name=cfg.partner_axis,
+                            deterministic=cfg.deterministic_reduce)
             if faulted:
                 # a round with zero survivors (every coalition member
                 # dropped) keeps the global params instead of aggregating
@@ -806,11 +1009,25 @@ class MplTrainer:
                 stale = self._push_stale(stale, params)
             return (agg, theta, vl_h, va_h, p_h, stale, upd_h, w_h), None
 
-        (params, theta, vl_h, va_h, p_h, stale, upd_h, w_h), _ = lax.scan(
-            mb_body, (state.params, state.theta, state.val_loss_h,
-                      state.val_acc_h, state.partner_h, state.stale,
-                      state.upd_h, state.w_h),
-            jnp.arange(cfg.minibatch_count))
+        carry = (state.params, state.theta, state.val_loss_h,
+                 state.val_acc_h, state.partner_h, state.stale,
+                 state.upd_h, state.w_h)
+        if cfg.deterministic_reduce:
+            # trace-time unroll instead of lax.scan: a round body INSIDE a
+            # while loop compiles differently per device/topology on this
+            # toolchain (the numerics audit's localization — even a
+            # length-1 scan wrapping the pass+collective block breaks
+            # cross-topology bit-identity), while the identical blocks
+            # unrolled at top level compile stably. minibatch_count is
+            # static, so the unroll is exact, not an approximation (and
+            # the python-int minibatch index makes the hoisted-stream
+            # slicing and history writes static ops).
+            for _mb in range(cfg.minibatch_count):
+                carry, _ = mb_body(carry, _mb)
+        else:
+            carry, _ = lax.scan(mb_body, carry,
+                                jnp.arange(cfg.minibatch_count))
+        (params, theta, vl_h, va_h, p_h, stale, upd_h, w_h) = carry
         return state._replace(params=params, theta=theta, val_loss_h=vl_h,
                               val_acc_h=va_h, partner_h=p_h, stale=stale,
                               upd_h=upd_h, w_h=w_h)
@@ -911,8 +1128,10 @@ class MplTrainer:
                 jnp.stack([losses, accs, pvl, pva]), mode="drop")
 
             w = aggregation_weights(cfg.aggregator, act_mask, slot_sizes,
-                                    jnp.nan_to_num(pva))
-            agg = aggregate(new_params, w)
+                                    jnp.nan_to_num(pva),
+                                    deterministic=cfg.deterministic_reduce)
+            agg = aggregate(new_params, w,
+                            deterministic=cfg.deterministic_reduce)
             if faulted:
                 # zero survivors this round: keep the global params
                 agg = tree_where(jnp.sum(act_mask) > 0, agg, params)
@@ -991,8 +1210,10 @@ class MplTrainer:
 
             if cfg.approach == "seqavg":
                 w = aggregation_weights(cfg.aggregator, coal_mask, stacked.sizes,
-                                        jnp.nan_to_num(p_h[3, :, e, mb_i]))
-                params = aggregate(partner_stack, w)
+                                        jnp.nan_to_num(p_h[3, :, e, mb_i]),
+                                        deterministic=cfg.deterministic_reduce)
+                params = aggregate(partner_stack, w,
+                                   deterministic=cfg.deterministic_reduce)
             return (params, partner_stack, vl_h, va_h, p_h), None
 
         (params, partner_stack, vl_h, va_h, p_h), _ = lax.scan(
@@ -1002,8 +1223,10 @@ class MplTrainer:
 
         if cfg.approach == "seq-with-final-agg":
             w = aggregation_weights(cfg.aggregator, coal_mask, stacked.sizes,
-                                    jnp.nan_to_num(p_h[3, :, e, cfg.minibatch_count - 1]))
-            params = aggregate(partner_stack, w)
+                                    jnp.nan_to_num(p_h[3, :, e, cfg.minibatch_count - 1]),
+                                    deterministic=cfg.deterministic_reduce)
+            params = aggregate(partner_stack, w,
+                               deterministic=cfg.deterministic_reduce)
         return state._replace(params=params, val_loss_h=vl_h, val_acc_h=va_h,
                               partner_h=p_h)
 
@@ -1090,8 +1313,10 @@ class MplTrainer:
 
             if cfg.approach == "seqavg":
                 w = aggregation_weights(cfg.aggregator, active, slot_sizes,
-                                        jnp.nan_to_num(pva_slots))
-                params = aggregate(partner_stack, w)
+                                        jnp.nan_to_num(pva_slots),
+                                        deterministic=cfg.deterministic_reduce)
+                params = aggregate(partner_stack, w,
+                                   deterministic=cfg.deterministic_reduce)
             return (params, partner_stack, vl_h, va_h, p_h, pva_slots), None
 
         pva_init = jnp.full((K,), jnp.nan, jnp.float32)
@@ -1104,8 +1329,10 @@ class MplTrainer:
             # pva_last is the final minibatch's per-slot val accuracy — the
             # slot view of the masked path's p_h[3, :, e, MB-1] column
             w = aggregation_weights(cfg.aggregator, active, slot_sizes,
-                                    jnp.nan_to_num(pva_last))
-            params = aggregate(partner_stack, w)
+                                    jnp.nan_to_num(pva_last),
+                                    deterministic=cfg.deterministic_reduce)
+            params = aggregate(partner_stack, w,
+                               deterministic=cfg.deterministic_reduce)
         return state._replace(params=params, val_loss_h=vl_h, val_acc_h=va_h,
                               partner_h=p_h)
 
@@ -1187,7 +1414,7 @@ class MplTrainer:
         return (e >= cfg.patience) & (cur > past)
 
     def run_epoch(self, state: TrainState, stacked, val: EvalSet,
-                  coal_mask, rng) -> TrainState:
+                  coal_mask, rng, streams=None) -> TrainState:
         """One epoch with done-freezing; safe inside scan/vmap."""
         cfg = self.cfg
         rng = jax.random.fold_in(rng, state.epoch)
@@ -1199,7 +1426,8 @@ class MplTrainer:
                 new = self._seq_slot_epoch(state, stacked, val, coal_mask,
                                            rng)
         elif cfg.approach in ("fedavg", "lflip"):
-            new = self._fedavg_epoch(state, stacked, val, coal_mask, rng)
+            new = self._fedavg_epoch(state, stacked, val, coal_mask, rng,
+                                     streams=streams)
         elif cfg.approach == "single":
             new = self._single_epoch(state, stacked, val, coal_mask, rng)
         else:
@@ -1228,7 +1456,23 @@ class MplTrainer:
         return tree_where(state.done, state, advanced)
 
     def epoch_chunk(self, state: TrainState, stacked, val: EvalSet,
-                    coal_mask, rng, n_epochs: int) -> TrainState:
+                    coal_mask, rng, n_epochs: int,
+                    streams_all=None) -> TrainState:
+        if self.cfg.deterministic_reduce:
+            # same trace-time unroll as the deterministic minibatch loop:
+            # epoch bodies inside a lax.scan compile per-topology on this
+            # toolchain; unrolled they compile stably (n_epochs is a
+            # static argument already). `streams_all` (the hoisted
+            # [E, ...] permutation/key stacks) slices per epoch here.
+            for i in range(n_epochs):
+                streams = (None if streams_all is None else
+                           jax.tree_util.tree_map(lambda a: a[i],
+                                                  streams_all))
+                state = self.run_epoch(state, stacked, val, coal_mask,
+                                       jax.random.fold_in(rng, i),
+                                       streams=streams)
+            return state
+
         def body(s, i):
             return self.run_epoch(s, stacked, val, coal_mask,
                                   jax.random.fold_in(rng, i)), None

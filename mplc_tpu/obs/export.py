@@ -150,6 +150,35 @@ def varz_view() -> dict:
                 out["program_bank"] = bank.bank_stats()
         except Exception as e:
             out["program_bank"] = {"error": str(e)[:200]}
+    # numeric-truth plane (obs/numerics.py): process-level view — knob
+    # states + the ledger/audit/drift counters. Carries no tenant
+    # identities or per-tenant values, so the PR-12 redaction walk has
+    # nothing to collapse here; the counters are aggregates by design.
+    try:
+        from .. import constants as _c
+        snap = out["metrics"].get("counters", {}) if isinstance(
+            out.get("metrics"), dict) else {}
+        import sys as _sys
+        _agg = _sys.modules.get("mplc_tpu.ops.aggregation")
+        out["numerics"] = {
+            "deterministic_reduce":
+                os.environ.get(_c.DETERMINISTIC_REDUCE_ENV, "") == "1",
+            # False = the optimization_barrier batching rule failed to
+            # install and deterministic mode's fence silently no-ops —
+            # the cross-topology bit-identity contract is weakened
+            # (None = the jax-backed module isn't loaded in this
+            # process; like the bank stats, never force-import jax here)
+            "fusion_fence_ok": (_agg._BARRIER_OK if _agg is not None
+                                else None),
+            "audit_enabled":
+                os.environ.get(_c.NUMERICS_AUDIT_ENV, "") == "1",
+            "ledger_path": os.environ.get(_c.NUMERICS_LEDGER_ENV) or None,
+            "ledger_records": snap.get("numerics.ledger_records", 0),
+            "audits": snap.get("numerics.audits", 0),
+            "drift_events": snap.get("numerics.drift_events", 0),
+        }
+    except Exception as e:
+        out["numerics"] = {"error": str(e)[:200]}
     return out
 
 

@@ -1,0 +1,551 @@
+"""Numeric-truth plane: value provenance, reduction audit, drift diffing.
+
+The telemetry plane (obs/trace.py, PR 10) and device-time truth
+(obs/devcost.py, PR 12) made *time* observable; this module makes
+*values* observable. Three parts:
+
+1. **Value-provenance ledger** (`ValueLedger`, `MPLC_TPU_NUMERICS_LEDGER`):
+   every harvested v(S) — exact engine, reconstruction, live — is recorded
+   with its EXACT float bits, a content hash, and float-path metadata
+   (topology, device count, reduction mode, slot width, OOM-ladder rungs
+   taken) keyed by (subset bitmask, engine fingerprint). Two ledgers —
+   runs, topologies, device counts — diff into per-subset ulp-distance
+   histograms, max/percentile drift, and the Kendall-tau of the induced
+   value ranking (`diff_ledgers`, `scripts/drift_diff.py`): per "On the
+   Volatility of Shapley-Based Contribution Metrics" (PAPERS.md), small
+   v(S) perturbations flip contributivity *rankings*, so drift is a
+   correctness metric, not a cosmetic one.
+
+2. **Per-device reduction audit** (`audit_coalition`,
+   `MPLC_TPU_NUMERICS_AUDIT=1`): at device-fence ordinals the engine
+   captures one audited coalition's per-round per-partner aggregation
+   terms through a SEPARATE instrumented recording run (the dispatched
+   sweep programs are never touched, so v(S) is bit-identical audit-on vs
+   audit-off — equality-tested incl. the fault ladder), then replays the
+   reduction orders on the host: the reference left-to-right fold vs the
+   sharded grouping (per-device partial sums + cross-device combine,
+   i.e. what a `psum` over `part` computes) — localizing the FIRST
+   divergent reduction (round, leaf, shard count) with exact ulp
+   distances. A detected order divergence emits a `numerics.drift` event
+   and a flight-recorder postmortem carrying the divergent leaf path and
+   the per-device partials.
+
+3. **Deterministic-reduction support** (`MPLC_TPU_DETERMINISTIC_REDUCE`):
+   the mode itself lives in ops/aggregation.py (`ordered_fold`) and
+   mpl/engine.py (stream hoisting, unrolled round loops, aux-drop); this
+   module holds the env plumbing and the audit that VERIFIES the pinned
+   order. What the audit established on this toolchain (full evidence in
+   DESIGN_NOTES.md "2-D shard_map numeric drift — closed"):
+
+     - the aggregation `psum` order (the original root-cause prose) is
+       only ONE root: the grouped reduction diverges from the linear fold
+       at ulp scale, which adam's sqrt(v)-normalized updates amplify
+       chaotically;
+     - a second, larger root is COMPILATION-CONTEXT sensitivity: the same
+       per-partner training pass embedded in programs that generate their
+       threefry streams next to a collective (or that run it at another
+       batch width inside a loop body) rounds a few lanes differently per
+       topology;
+     - both are eliminated by the deterministic mode's recipe — ordered
+       fold over all-gathered terms, rng/permutation streams hoisted into
+       a separate dispatch and passed as data, trace-time-unrolled round
+       loops, and one shard_map program family with `part=1` as the
+       unsharded reference — under which the 2-D partner-sharded path is
+       BIT-IDENTICAL to the unsharded reference
+       (tests/test_partner_shard.py, tests/test_numerics.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import struct
+import time
+
+import numpy as np
+
+from .. import constants
+from . import metrics as obs_metrics
+from . import trace as obs_trace
+
+logger = logging.getLogger("mplc_tpu")
+
+LEDGER_SCHEMA = 1
+
+
+def audit_enabled() -> bool:
+    """MPLC_TPU_NUMERICS_AUDIT=1 (default off)."""
+    return os.environ.get(constants.NUMERICS_AUDIT_ENV, "") == "1"
+
+
+def ledger_path_from_env() -> "str | None":
+    return os.environ.get(constants.NUMERICS_LEDGER_ENV) or None
+
+
+def reduction_mode() -> str:
+    from .. import constants as _c
+    return ("deterministic" if _c.deterministic_reduce_enabled()
+            else "default")
+
+
+# ---------------------------------------------------------------------------
+# float forensics
+# ---------------------------------------------------------------------------
+
+def float_bits(v: float) -> str:
+    """Exact IEEE-754 double bits of a Python float, as 16 hex chars —
+    the ledger's canonical value representation (`float(x)` of the stored
+    bits round-trips exactly; JSON's decimal repr also round-trips, the
+    hex form just makes bit-equality greppable)."""
+    return struct.pack(">d", float(v)).hex()
+
+
+def bits_to_float(bits: str) -> float:
+    return struct.unpack(">d", bytes.fromhex(bits))[0]
+
+
+def _ordinal(v: float) -> int:
+    """Monotonic integer mapping of a double: adjacent floats map to
+    adjacent integers, so |ordinal(a) - ordinal(b)| is the ulp distance."""
+    (i,) = struct.unpack(">q", struct.pack(">d", float(v)))
+    return i if i >= 0 else -(i & 0x7FFFFFFFFFFFFFFF)
+
+
+def ulp_distance(a: float, b: float) -> int:
+    """Units-in-the-last-place distance between two doubles (0 iff
+    bit-identical up to +/-0.0; NaNs compare infinite unless both NaN)."""
+    fa, fb = float(a), float(b)
+    if fa == fb:  # covers +0.0 vs -0.0
+        return 0
+    if np.isnan(fa) and np.isnan(fb):
+        return 0
+    if np.isnan(fa) or np.isnan(fb):
+        return int(2 ** 63 - 1)
+    return abs(_ordinal(fa) - _ordinal(fb))
+
+
+def ulp_distance_f32(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Elementwise ulp distance between two float32 arrays (the audit's
+    per-leaf forensics)."""
+    ia = np.ascontiguousarray(a, np.float32).view(np.int32).astype(np.int64)
+    ib = np.ascontiguousarray(b, np.float32).view(np.int32).astype(np.int64)
+    ia = np.where(ia >= 0, ia, -(ia & 0x7FFFFFFF))
+    ib = np.where(ib >= 0, ib, -(ib & 0x7FFFFFFF))
+    d = np.abs(ia - ib)
+    return np.where(np.asarray(a, np.float32) == np.asarray(b, np.float32),
+                    0, d)
+
+
+# ---------------------------------------------------------------------------
+# value-provenance ledger
+# ---------------------------------------------------------------------------
+
+class ValueLedger:
+    """In-memory ledger of harvested v(S) bits + float-path metadata,
+    keyed by (subset bitmask, engine fingerprint). One ledger per engine;
+    `save()` persists atomically as JSON (the artifact drift_diff.py and
+    the bench sidecar consume)."""
+
+    def __init__(self, engine_fingerprint: str, meta: dict | None = None,
+                 path: "str | None" = None):
+        self.engine_fingerprint = engine_fingerprint
+        self.meta = dict(meta or {})
+        self.path = path
+        self.entries: dict[str, dict] = {}
+
+    @staticmethod
+    def subset_key(subset) -> str:
+        """Canonical bitmask hex of a membership tuple."""
+        bits = 0
+        for i in subset:
+            bits |= 1 << int(i)
+        return hex(bits)
+
+    def record(self, subset, value: float, *, source: str = "exact",
+               slot_width: "int | None" = None,
+               cap_halvings: int = 0, degraded: bool = False) -> None:
+        key = self.subset_key(subset)
+        entry = {
+            "mask": key,
+            "value": float(value),
+            "value_bits": float_bits(value),
+            "source": source,
+            "slot_width": slot_width,
+            "cap_halvings": int(cap_halvings),
+            "degraded": bool(degraded),
+        }
+        body = json.dumps({**entry, "fingerprint": self.engine_fingerprint,
+                           **{k: self.meta.get(k) for k in
+                              ("topology", "part_shards", "n_devices",
+                               "reduction_mode")}},
+                          sort_keys=True)
+        entry["content_hash"] = hashlib.sha256(body.encode()).hexdigest()[:16]
+        self.entries[key] = entry
+        obs_metrics.counter("numerics.ledger_records").inc()
+
+    def to_doc(self) -> dict:
+        return {
+            "schema": LEDGER_SCHEMA,
+            "engine_fingerprint": self.engine_fingerprint,
+            "meta": self.meta,
+            "entries": self.entries,
+        }
+
+    def values_bits(self) -> dict:
+        """{mask_hex: value_bits} — the compact map the bench sidecar
+        embeds for the bench_diff numerics gate."""
+        return {k: e["value_bits"] for k, e in self.entries.items()}
+
+    def save(self, path: "str | None" = None) -> "str | None":
+        """Atomic write (temp + os.replace); never raises — a ledger that
+        can kill a sweep over a full disk is worse than a gap in it."""
+        path = path or self.path
+        if not path:
+            return None
+        try:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.to_doc(), f)
+            os.replace(tmp, path)
+            obs_trace.event("numerics.ledger", path=str(path),
+                            entries=len(self.entries),
+                            reduction_mode=self.meta.get("reduction_mode"))
+            return path
+        except OSError as e:
+            logger.error("numerics ledger save to %r failed: %s", path, e)
+            return None
+
+    @classmethod
+    def load(cls, path: str) -> "ValueLedger":
+        with open(path) as f:
+            doc = json.load(f)
+        led = cls(doc.get("engine_fingerprint", "?"), doc.get("meta"),
+                  path=path)
+        led.entries = dict(doc.get("entries", {}))
+        return led
+
+
+def _discordant_pairs(ranks: np.ndarray) -> int:
+    """Strict inversions in a rank sequence via a binary indexed tree —
+    O(n log n), the count Knight's tau algorithm needs (ties are not
+    inversions)."""
+    m = int(ranks.max()) + 1
+    tree = [0] * (m + 1)
+    disc = 0
+    for seen, r in enumerate(ranks):
+        r = int(r)
+        # earlier elements with rank strictly greater than r
+        s, i = 0, r
+        while i > 0:
+            s += tree[i]
+            i -= i & (-i)
+        disc += seen - s
+        i = r
+        while i <= m:
+            tree[i] += 1
+            i += i & (-i)
+    return disc
+
+
+def kendall_tau_b(a, b) -> "float | None":
+    """Kendall tau-b over two paired value lists (the induced subset
+    ranking agreement, tie-aware: two bit-identical ledgers score exactly
+    1.0 even when some subsets share a value). None below two pairs.
+
+    Knight's O(n log n) formulation — the ledgers this compares hold one
+    entry per SUBSET (2^P - 1 of them), so a quadratic pair loop would
+    hang the drift gate at the partner counts the engine already serves."""
+    n = len(a)
+    if n < 2:
+        return None
+    a = np.asarray(a, float)
+    b = np.asarray(b, float)
+    order = np.lexsort((b, a))
+    a_s, b_s = a[order], b[order]
+
+    def ties(counts: np.ndarray) -> int:
+        return int((counts * (counts - 1) // 2).sum())
+
+    n0 = n * (n - 1) // 2
+    n1 = ties(np.unique(a_s, return_counts=True)[1])
+    n2 = ties(np.unique(b_s, return_counts=True)[1])
+    n3 = ties(np.unique(np.stack([a_s, b_s], axis=1), axis=0,
+                        return_counts=True)[1])
+    # b ranks in a-major order: within equal-a runs lexsort sorted b
+    # ascending, so a-tied pairs contribute no inversions; b-ties are
+    # not strict inversions either — exactly the discordant-pair count
+    ranks = np.unique(b_s, return_inverse=True)[1] + 1
+    disc = _discordant_pairs(ranks)
+    conc_minus_disc = n0 - n1 - n2 + n3 - 2 * disc
+    denom = ((n0 - n1) * (n0 - n2)) ** 0.5
+    return conc_minus_disc / denom if denom else None
+
+
+# backward-compatible internal alias (tests + diff_ledgers call sites)
+_kendall_tau = kendall_tau_b
+
+
+def diff_ledgers(a, b) -> dict:
+    """Compare two ledgers (ValueLedger or their to_doc() dicts).
+
+    Returns {comparable, same_fingerprint, common, only_a, only_b,
+    ulp: {max, p50, p99, nonzero}, histogram (log2-bucketed ulp counts),
+    kendall_tau, drift}: `drift` is True when any common subset's value
+    bits differ. Fingerprint-mismatched ledgers describe different GAMES
+    — deltas are reported but flagged not comparable."""
+    da = a.to_doc() if isinstance(a, ValueLedger) else a
+    db = b.to_doc() if isinstance(b, ValueLedger) else b
+    ea, eb = da.get("entries", {}), db.get("entries", {})
+    common = sorted(set(ea) & set(eb))
+    same_fp = (da.get("engine_fingerprint") == db.get("engine_fingerprint"))
+    dists = []
+    va, vb = [], []
+    per_subset = {}
+    for k in common:
+        x = bits_to_float(ea[k]["value_bits"])
+        y = bits_to_float(eb[k]["value_bits"])
+        d = ulp_distance(x, y)
+        dists.append(d)
+        per_subset[k] = d
+        va.append(x)
+        vb.append(y)
+    hist: dict[str, int] = {}
+    for d in dists:
+        if d == 0:
+            bucket = "0"
+        else:
+            bucket = f"2^{max(int(d).bit_length() - 1, 0)}"
+        hist[bucket] = hist.get(bucket, 0) + 1
+    sd = sorted(dists)
+
+    def pct(q):
+        if not sd:
+            return None
+        return sd[min(max(int(q * len(sd)), 1), len(sd)) - 1]
+
+    return {
+        "comparable": same_fp and bool(common),
+        "same_fingerprint": same_fp,
+        "common": len(common),
+        "only_a": len(set(ea) - set(eb)),
+        "only_b": len(set(eb) - set(ea)),
+        "ulp": {
+            "max": max(dists) if dists else None,
+            "p50": pct(0.50),
+            "p99": pct(0.99),
+            "nonzero": sum(1 for d in dists if d),
+        },
+        "histogram": hist,
+        "per_subset": per_subset,
+        "kendall_tau": _kendall_tau(va, vb),
+        "drift": any(dists),
+        "meta_a": da.get("meta", {}),
+        "meta_b": db.get("meta", {}),
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-device reduction audit
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AuditResult:
+    subset: tuple
+    rounds: int
+    partners: int
+    shard_counts: tuple
+    # the grouping the ENGINE actually executes: the 2-D pipe's
+    # part_shards under the default (psum) order, or None when the
+    # executed reduction is the linear reference order itself (every
+    # 1-D engine, and every deterministic-reduce engine at any shards)
+    executed_shards: "int | None"
+    # first (round, leaf_path, shard_count) where the EXECUTED grouping
+    # diverges from the reference linear fold — None when the executed
+    # order is the reference order or agrees bit-exactly
+    first_divergence: "tuple | None"
+    # max ulp of the executed-order divergence (0 when none)
+    max_ulp: int
+    divergent_elements: int
+    # the hypothetical table: per-CANDIDATE-shard-count max ulp across
+    # all rounds/leaves — what sharding at s devices WOULD do to this
+    # coalition's reductions, recorded in every mode as evidence
+    ulp_by_shards: dict
+    # the audited run's per-device partial sums at the first divergent
+    # reduction (host-derived, linear order within each device's block)
+    partials_at_divergence: "list | None"
+    seconds: float
+
+
+def _linear_fold(terms: np.ndarray) -> np.ndarray:
+    """Strict left-to-right fold over axis 0 in float32 — the reference
+    (and deterministic-mode) reduction order, replayed exactly on host
+    (NumPy float32 adds are IEEE single adds, bit-equal to the device's
+    unfused adds)."""
+    out = terms[0].astype(np.float32)
+    for i in range(1, terms.shape[0]):
+        out = out + terms[i].astype(np.float32)
+    return out
+
+
+def _grouped_fold(terms: np.ndarray, shards: int) -> np.ndarray:
+    """The sharded grouping: per-device partial sums over contiguous
+    partner blocks (linear within the block), then a linear cross-device
+    combine — the order a `psum` over a part axis of `shards` devices
+    induces on the same terms."""
+    P = terms.shape[0]
+    block = P // shards
+    partials = [_linear_fold(terms[d * block:(d + 1) * block])
+                for d in range(shards)]
+    return _linear_fold(np.stack(partials))
+
+
+def _device_partials(terms: np.ndarray, shards: int) -> list:
+    P = terms.shape[0]
+    block = P // shards
+    return [_linear_fold(terms[d * block:(d + 1) * block])
+            for d in range(shards)]
+
+
+def audit_coalition(engine, subset) -> "AuditResult | None":
+    """Capture one coalition's per-round per-partner aggregation terms
+    through a separate instrumented (record_updates) run and localize the
+    first reduction step where a sharded grouping diverges from the
+    reference linear fold.
+
+    Touches NOTHING the engine serves: separate trainer instance,
+    separate TrainState, no memo/cache writes — v(S) is bit-identical
+    with the audit on or off (equality-tested, tests/test_numerics.py).
+    Returns None when the game shape can't be audited (non-fedavg
+    approach, early stopping on, seed ensembles). Never raises."""
+    t0 = time.perf_counter()
+    try:
+        import jax
+
+        from ..mpl.engine import MplTrainer
+
+        cfg = engine._multi_cfg
+        if (cfg.approach != "fedavg" or cfg.is_early_stopping
+                or getattr(engine, "seed_ensemble", 1) > 1):
+            return None
+        subset = tuple(sorted(int(i) for i in subset))
+        eff = engine._effective_subset(subset)
+        if len(eff) < 2:
+            return None  # singles never aggregate
+        audit_cfg = dataclasses.replace(
+            cfg, record_updates=True, partner_axis=None, slot_count=None)
+        trainer = MplTrainer.get(engine.model, audit_cfg)
+        rng = engine._coalition_rng(eff)
+        P = engine.partners_count
+        mask = np.zeros((P,), np.float32)
+        mask[list(subset)] = 1.0
+        state = trainer.init_state(rng, P)
+        state = trainer.jit_epoch_chunk(
+            state, engine.stacked, engine.val,
+            jax.numpy.asarray(mask), rng, n_epochs=cfg.epoch_count)
+        upd_h = [np.asarray(leaf) for leaf in
+                 jax.tree_util.tree_leaves(state.upd_h)]   # [R, P, ...]
+        leaf_paths = [
+            "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                     for k in path)
+            for path, _ in jax.tree_util.tree_flatten_with_path(
+                state.upd_h)[0]]
+        w_h = np.asarray(state.w_h)                        # [R, P]
+        R = w_h.shape[0]
+
+        # candidate shard counts: the engine's actual 2-D width plus
+        # every divisor of P — the replay is a HOST computation, so a
+        # single-device run still audits what sharding at any width
+        # WOULD do to its reductions
+        cands = sorted({s for s in range(2, P + 1) if P % s == 0})
+        # the grouping the engine ACTUALLY executes: default-order psum
+        # over the 2-D pipe's part axis; every other configuration (1-D
+        # engines, deterministic-reduce at any shards) executes the
+        # linear reference order itself
+        pipe2d = getattr(engine, "_pipe2d", None)
+        executed = None
+        if (pipe2d is not None and pipe2d.part_shards > 1
+                and not cfg.deterministic_reduce):
+            executed = pipe2d.part_shards
+            cands = sorted(set(cands) | {executed})
+        if not cands:
+            return None
+
+        first = None
+        partials = None
+        max_ulp = 0
+        diverged = 0
+        by_shards = {s: 0 for s in cands}
+        for r in range(R):
+            w = w_h[r]
+            if not np.any(w):
+                continue  # round never reached / zero survivors
+            for leaf, path in zip(upd_h, leaf_paths):
+                terms = leaf[r] * w.reshape((-1,) + (1,) * (leaf.ndim - 2))
+                terms = terms.astype(np.float32)
+                ref = _linear_fold(terms)
+                for s in cands:
+                    grouped = _grouped_fold(terms, s)
+                    d = ulp_distance_f32(ref, grouped)
+                    dmax = int(d.max()) if d.size else 0
+                    by_shards[s] = max(by_shards[s], dmax)
+                    if dmax and s == executed:
+                        # executed-order divergence: the localized drift
+                        diverged += int((d > 0).sum())
+                        max_ulp = max(max_ulp, dmax)
+                        if first is None:
+                            first = (r, path, s)
+                            partials = [p.tolist() if p.size <= 8
+                                        else {"shape": list(p.shape),
+                                              "max": float(np.max(p)),
+                                              "min": float(np.min(p))}
+                                        for p in _device_partials(terms, s)]
+        res = AuditResult(
+            subset=subset, rounds=R, partners=P,
+            shard_counts=tuple(cands), executed_shards=executed,
+            first_divergence=first,
+            max_ulp=max_ulp, divergent_elements=diverged,
+            ulp_by_shards=by_shards, partials_at_divergence=partials,
+            seconds=time.perf_counter() - t0)
+        obs_metrics.counter("numerics.audits").inc()
+        obs_trace.event(
+            "numerics.audit", dur=res.seconds,
+            subset=ValueLedger.subset_key(subset), rounds=R,
+            shard_counts=list(cands), executed_shards=executed,
+            max_ulp=max_ulp,
+            hypothetical_max_ulp=max(by_shards.values(), default=0),
+            divergent_elements=diverged,
+            first_round=None if first is None else first[0],
+            first_leaf=None if first is None else first[1],
+            reduction_mode=("deterministic" if cfg.deterministic_reduce
+                            else "default"))
+        if first is not None:
+            # reduction-order divergence localized: in the default mode
+            # this is the expected psum-order root cause made concrete;
+            # under deterministic-reduce it would mean the pinned order
+            # is NOT holding — either way it is flight-recorder material
+            obs_metrics.counter("numerics.drift_events").inc()
+            obs_trace.event(
+                "numerics.drift",
+                subset=ValueLedger.subset_key(subset),
+                round=first[0], leaf=first[1], shards=first[2],
+                max_ulp=max_ulp,
+                reduction_mode=("deterministic" if cfg.deterministic_reduce
+                                else "default"))
+            from . import flight as obs_flight
+            obs_flight.dump("numerics_drift", extra={
+                "subset": list(subset),
+                "first_divergent_round": first[0],
+                "divergent_leaf": first[1],
+                "shard_count": first[2],
+                "max_ulp": max_ulp,
+                "divergent_elements": diverged,
+                "ulp_by_shards": {str(k): v for k, v in by_shards.items()},
+                "per_device_partials": partials,
+            })
+        return res
+    except Exception as e:  # noqa: BLE001 — the audit must never kill a sweep
+        logger.warning("numerics audit for %r failed: %s", subset, e)
+        return None

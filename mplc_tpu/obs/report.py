@@ -130,6 +130,12 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     per_method: dict = {}
     live_queries: list = []         # (dur, attrs) of live.query events
     live_appends = live_recovers = 0
+    # numeric-truth plane (obs/numerics.py): audit/drift events and the
+    # last ledger-persist event
+    num_audits = num_drift = 0
+    num_max_ulp = 0
+    num_mode = None
+    num_ledger = None
     recon_batches = recon_coalitions = 0
     recon_s = 0.0
     recorded = None
@@ -312,6 +318,16 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             # service.job_retries counter this row mirrors
             tn = a.get("tenant", "?")
             svc_job_faults[tn] = svc_job_faults.get(tn, 0) + 1
+        elif name == "numerics.audit":
+            num_audits += 1
+            num_max_ulp = max(num_max_ulp, int(a.get("max_ulp") or 0))
+            num_mode = a.get("reduction_mode") or num_mode
+        elif name == "numerics.drift":
+            num_drift += 1
+        elif name == "numerics.ledger":
+            # one persist per evaluate(); the last event carries the
+            # final entry count
+            num_ledger = dict(a)
         elif name == "live.query":
             live_queries.append((dur, a))
         elif name == "live.append":
@@ -673,6 +689,19 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
                 "retries": svc_job_faults.get(tn, 0),
             }
         report["slo"] = slo
+    if num_audits or num_drift or num_ledger is not None:
+        # the numeric-truth row: reduction audits run, order divergences
+        # localized (with the worst ulp distance), and the ledger's
+        # persisted size — old record streams produce no row at all
+        report["numerics"] = {
+            "audits": num_audits,
+            "drift_events": num_drift,
+            "max_ulp": num_max_ulp,
+            "reduction_mode": (num_mode
+                               or (num_ledger or {}).get("reduction_mode")),
+            "ledger_entries": (num_ledger or {}).get("entries"),
+            "ledger_path": (num_ledger or {}).get("path"),
+        }
     if trust is not None:
         report["trust"] = trust
     if fits:
@@ -751,6 +780,16 @@ def format_report(report: dict) -> str:
             line += f"  ladder_exhausted={r['ladder_exhausted']}"
         if r.get("faults_injected"):
             line += f"  faults_injected={r['faults_injected']}"
+        lines.append(line)
+    nm = report.get("numerics")
+    if nm is not None:
+        # the numeric-truth row: reduction mode, audits run, localized
+        # order divergences (worst ulp distance), ledger size
+        line = (f"  numerics    mode={nm.get('reduction_mode') or '?'}  "
+                f"audits={nm['audits']}  drift_events={nm['drift_events']}"
+                f"  max_ulp={nm['max_ulp']}")
+        if nm.get("ledger_entries") is not None:
+            line += f"  ledger_entries={nm['ledger_entries']}"
         lines.append(line)
     svc = report.get("service")
     if svc is not None:

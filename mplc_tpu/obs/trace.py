@@ -118,6 +118,14 @@ SPAN_REGISTRY = {
     "service.journal_broken": "WAL append failure (journaling disabled)",
     "flight.dump": "flight-recorder postmortem written (attrs: reason/"
                    "path)",
+    "numerics.audit": "per-device reduction audit of one coalition "
+                      "(attrs: subset/rounds/shard_counts/max_ulp/"
+                      "first_round/first_leaf/reduction_mode)",
+    "numerics.drift": "reduction-order divergence localized (attrs: "
+                      "subset/round/leaf/shards/max_ulp) — also dumps a "
+                      "flight-recorder postmortem",
+    "numerics.ledger": "value-provenance ledger persisted (attrs: path/"
+                       "entries/reduction_mode)",
 }
 
 

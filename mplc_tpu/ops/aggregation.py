@@ -26,9 +26,69 @@ import jax.numpy as jnp
 AGGREGATOR_NAMES = ("uniform", "data-volume", "local-score")
 
 
+def _install_barrier_batching_rule() -> bool:
+    """Register the (missing) trivial batching rule for
+    `optimization_barrier` on this toolchain: the barrier is an identity
+    op, so batching passes every operand through with its batch dim
+    unchanged. Without the rule, a barrier anywhere under the engine's
+    coalition `vmap` raises NotImplementedError — and the
+    deterministic-reduce mode needs barriers INSIDE the vmapped trainer
+    (`fusion_fence`) to pin cross-boundary fusion. Returns False (and
+    deterministic mode degrades to fence-less, still fold-ordered) if
+    the internal primitive moved."""
+    try:
+        from jax._src.lax import lax as _lax_internal
+        from jax.interpreters import batching as _batching
+        p = _lax_internal.optimization_barrier_p
+        if p not in _batching.primitive_batchers:
+            _batching.primitive_batchers[p] = \
+                lambda args, dims: (p.bind(*args), dims)
+        return True
+    except Exception:  # pragma: no cover — toolchain drift
+        return False
+
+
+_BARRIER_OK = _install_barrier_batching_rule()
+
+
+def fusion_fence(tree):
+    """`optimization_barrier` over a pytree, usable under vmap (the
+    batching rule above). The deterministic-reduction mode uses it to cut
+    XLA fusion across chosen boundaries — e.g. between the rng/permutation
+    generation and the training pass that consumes them, or between the
+    weighting multiply and the ordered fold — because cross-boundary
+    fusion (FMA formation, consumer-driven tiling) rounds differently per
+    program embedding and breaks cross-topology bit-identity. Semantically
+    the identity function; no-op if the rule could not be installed."""
+    if not _BARRIER_OK:
+        return tree
+    return jax.lax.optimization_barrier(tree)
+
+
+def ordered_fold(terms: jax.Array) -> jax.Array:
+    """Strict left-to-right fold over axis 0: ((t0 + t1) + t2) + ...
+
+    The deterministic-reduction primitive (MPLC_TPU_DETERMINISTIC_REDUCE,
+    obs/numerics.py): explicit chained adds pin the reduction order — XLA
+    does not reassociate them the way it may an opaque `reduce`/`psum` —
+    so the result is bit-identical wherever the fold runs: one device, or
+    every shard of an N-device mesh after an `all_gather` restored the
+    global partner order. A left fold (not a pairwise tree) on purpose:
+    partial sums are insensitive to exactly-zero terms riding along
+    (x + 0.0 == x bitwise), which is the property that keeps the slot
+    path (k compact terms) and the masked path (k active terms spread
+    over P rows) bit-identical — a balanced tree re-pairs around zero
+    rows and loses it."""
+    out = terms[0]
+    for i in range(1, terms.shape[0]):
+        out = out + terms[i]
+    return out
+
+
 def aggregation_weights(kind: str, coalition_mask: jax.Array,
                         sizes: jax.Array, last_scores: jax.Array,
-                        axis_name: str | None = None) -> jax.Array:
+                        axis_name: str | None = None,
+                        deterministic: bool = False) -> jax.Array:
     """Build the normalized weight vector w[P] for one aggregation step.
 
     kind: 'uniform' | 'data-volume' | 'local-score'
@@ -37,6 +97,10 @@ def aggregation_weights(kind: str, coalition_mask: jax.Array,
     last_scores: [P] last-round val accuracy (local-score policy).
     axis_name: if the partner axis is sharded over a mesh axis (shard_map),
         its name — normalization then uses the GLOBAL total via `psum`.
+    deterministic: fixed-order total (MPLC_TPU_DETERMINISTIC_REDUCE): the
+        [P] raw weights are folded strictly left-to-right — all-gathered
+        into global partner order first when sharded — so the normalizer
+        is bit-identical on 1 and N devices.
     """
     if kind == "uniform":
         raw = coalition_mask
@@ -47,13 +111,25 @@ def aggregation_weights(kind: str, coalition_mask: jax.Array,
     else:
         raise KeyError(f"aggregation approach '{kind}' is not a valid approach. "
                        f"Supported: {AGGREGATOR_NAMES}")
-    total = jnp.sum(raw)
-    if axis_name is not None:
-        total = jax.lax.psum(total, axis_name)
+    if deterministic:
+        if axis_name is not None:
+            full = jax.lax.all_gather(raw, axis_name, axis=0, tiled=True)
+        else:
+            # fence so the fold sees the same materialized terms the
+            # sharded path's all_gather produces — without it XLA fuses
+            # the producing multiply into the fold's adds (FMA), and the
+            # different rounding breaks 1-vs-N-device bit-identity
+            full = fusion_fence(raw)
+        total = ordered_fold(full)
+    else:
+        total = jnp.sum(raw)
+        if axis_name is not None:
+            total = jax.lax.psum(total, axis_name)
     return raw / jnp.maximum(total, 1e-12)
 
 
-def aggregate(stacked_params, weights: jax.Array, axis_name: str | None = None):
+def aggregate(stacked_params, weights: jax.Array, axis_name: str | None = None,
+              deterministic: bool = False):
     """Fused weighted mean over the partner axis, per pytree leaf.
 
     stacked_params: pytree with leaves [P, ...]; weights: [P].
@@ -61,10 +137,31 @@ def aggregate(stacked_params, weights: jax.Array, axis_name: str | None = None):
     partial sums are `psum`ed over the mesh axis the partner dimension is
     sharded on — this is the framework's cross-chip weight "communication"
     (one reduce per aggregation, riding ICI).
+
+    deterministic (MPLC_TPU_DETERMINISTIC_REDUCE): instead of the
+    order-sensitive local-`sum` + `psum` pair, each leaf's weighted terms
+    are folded strictly left-to-right in GLOBAL partner order
+    (`ordered_fold`); when sharded, the terms are `all_gather`ed over the
+    partner mesh axis first — the collective moves bytes but performs no
+    arithmetic, so the fold is the same computation on the same values
+    everywhere, and the partner-sharded result is bit-identical to the
+    unsharded one (tests/test_partner_shard.py, tests/test_numerics.py).
     """
     def reduce_leaf(leaf):
         w = weights.astype(leaf.dtype).reshape((-1,) + (1,) * (leaf.ndim - 1))
-        s = jnp.sum(leaf * w, axis=0)
+        terms = leaf * w
+        if deterministic:
+            if axis_name is not None:
+                terms = jax.lax.all_gather(terms, axis_name, axis=0,
+                                           tiled=True)
+            else:
+                # same materialization fence as the sharded path's
+                # all_gather: stop XLA from fusing the weighting multiply
+                # into the fold's adds (FMA rounds differently), which
+                # would break 1-vs-N-device bit-identity
+                terms = fusion_fence(terms)
+            return ordered_fold(terms)
+        s = jnp.sum(terms, axis=0)
         return jax.lax.psum(s, axis_name) if axis_name is not None else s
     return jax.tree_util.tree_map(reduce_leaf, stacked_params)
 

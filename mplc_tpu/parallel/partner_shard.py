@@ -124,17 +124,39 @@ class PartnerShardedTrainer:
                     n_epochs: int) -> TrainState:
         from ..mpl.engine import buffer_donation_enabled
         don = buffer_donation_enabled()
+        hoist = self.trainer._det_hoist_streams()
         key = ("run", n_epochs, don)
         if key not in self._jits:
-            f = shard_map_norep(
-                partial(self.trainer.epoch_chunk, n_epochs=n_epochs),
-                mesh=self.mesh,
-                in_specs=(self._st, self._sp, P(), P(self.axis), P()),
-                out_specs=self._st)
+            if hoist:
+                # deterministic-reduce: the hoisted permutation/key
+                # stacks enter as data, partner-sliced over the mesh axis
+                # (obs/numerics.py — in-program stream generation beside
+                # the aggregation collective breaks bit-identity)
+                stream_specs = (P(None, self.axis, None),
+                                P(None, None, self.axis, None))
+                f = shard_map_norep(
+                    partial(self.trainer._epoch_chunk_streams,
+                            n_epochs=n_epochs),
+                    mesh=self.mesh,
+                    in_specs=(self._st, self._sp, P(), P(self.axis), P(),
+                              stream_specs),
+                    out_specs=self._st)
+            else:
+                f = shard_map_norep(
+                    partial(self.trainer.epoch_chunk, n_epochs=n_epochs),
+                    mesh=self.mesh,
+                    in_specs=(self._st, self._sp, P(), P(self.axis), P()),
+                    out_specs=self._st)
             # same donation policy as the trainer's own state-carrying
             # jits: the input state is dead after every chunk call
             self._jits[key] = jax.jit(
                 f, donate_argnums=(0,) if don else ())
+        if hoist:
+            streams = self.trainer.jit_gen_streams(
+                rng, n_epochs, stacked.mask, batched=False,
+                start_epoch=state.epoch)
+            return self._jits[key](state, stacked, val, coal_mask, rng,
+                                   streams)
         return self._jits[key](state, stacked, val, coal_mask, rng)
 
     def finalize(self, state: TrainState, test: EvalSet):

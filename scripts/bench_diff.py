@@ -17,7 +17,10 @@ Usage:
 Directory mode pairs up `telemetry_config*.json` files by name and
 diffs each pair (files present on only one side are reported, not
 fatal). Exit codes: 0 = no regression, 1 = at least one row regressed
-past the threshold, 2 = usage/JSON error.
+past the threshold, 2 = usage/JSON error, no matching pairs, or
+matched pairs that shared NO comparable rows at all (a gate that
+compared nothing must not read green — but a pair merely missing some
+newer rows still gates the rest).
 
 Every compared row is DIRECTION-aware ("lower" = smaller is better,
 "higher" = bigger is better); rows missing from either side are skipped
@@ -26,6 +29,13 @@ regression). Provenance guards: a fresh number diffed against a
 `cpu_fallback` or `replayed_cache` sidecar is flagged as incomparable
 (the scales differ), and a `degraded: true` side is annotated — a
 number earned through the OOM ladder is not a like-for-like baseline.
+
+Value-truth gate: sidecars carrying a `numerics` block (the
+obs/numerics.py ledger digest) additionally diff their per-subset v(S)
+bits — same-fingerprint runs whose values drifted fail regardless of
+the perf threshold (`numerics.max_ulp` / `numerics.p99_ulp` /
+`numerics.rank_tau` rows); pre-numerics sidecars skip the gate
+silently, fingerprint mismatches are noted and never gated.
 """
 
 from __future__ import annotations
@@ -99,6 +109,101 @@ def _provenance(doc: dict) -> str:
     return str(doc.get("source") or "fresh")
 
 
+def _ulp_distance(a_bits: str, b_bits: str) -> int:
+    """ulp distance between two hex-encoded double bit patterns (the
+    ledger's value encoding — obs/numerics.py), dependency-free so the
+    gate runs without importing the package."""
+    import struct
+
+    def ordinal(bits: str) -> int:
+        (i,) = struct.unpack(">q", bytes.fromhex(bits))
+        return i if i >= 0 else -(i & 0x7FFFFFFFFFFFFFFF)
+
+    if a_bits == b_bits:
+        return 0
+    return abs(ordinal(a_bits) - ordinal(b_bits))
+
+
+def _kendall_tau(a: list, b: list):
+    """Tie-aware Kendall tau-b (identical lists score exactly 1.0).
+    Delegates to the package's O(n log n) Knight implementation — the
+    ledger holds one value per SUBSET, so a quadratic pair loop would
+    hang the gate at real partner counts; the quadratic fallback below
+    only covers running this script with the package unimportable, and
+    caps itself rather than hang."""
+    n = len(a)
+    if n < 2:
+        return None
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from mplc_tpu.obs.numerics import kendall_tau_b
+        return kendall_tau_b(a, b)
+    except ImportError:
+        pass
+    if n > 4096:  # quadratic fallback: refuse to hang, report nothing
+        return None
+    conc = disc = ties_a = ties_b = 0
+    for i in range(n):
+        for j in range(i + 1, n):
+            da, db = a[i] - a[j], b[i] - b[j]
+            if da == 0 and db == 0:
+                continue
+            if da == 0:
+                ties_a += 1
+            elif db == 0:
+                ties_b += 1
+            elif da * db > 0:
+                conc += 1
+            else:
+                disc += 1
+    denom = ((conc + disc + ties_a) * (conc + disc + ties_b)) ** 0.5
+    return (conc - disc) / denom if denom else None
+
+
+def _numerics_rows(old: dict, new: dict, notes: list):
+    """The value-truth gate: when BOTH sidecars carry a `numerics` block
+    (obs/numerics.py ledger digest: engine fingerprint + per-subset value
+    bits), any bit drift between same-game runs is a regression — v(S)
+    changed, which is a correctness event, not a perf delta. Sidecars
+    that PREDATE the block are skipped silently (schema growth is never
+    a regression), and fingerprint mismatches are noted, never gated
+    (different games are not drift)."""
+    no, nn = old.get("numerics"), new.get("numerics")
+    if not (isinstance(no, dict) and isinstance(nn, dict)):
+        return []
+    if no.get("engine_fingerprint") != nn.get("engine_fingerprint"):
+        notes.append("numerics: engine fingerprints differ — different "
+                     "games, value drift not gated")
+        return []
+    vo, vn = no.get("values") or {}, nn.get("values") or {}
+    common = sorted(set(vo) & set(vn))
+    if not common:
+        return []
+    import struct
+    dists = [_ulp_distance(vo[k], vn[k]) for k in common]
+    fo = [struct.unpack(">d", bytes.fromhex(vo[k]))[0] for k in common]
+    fn_ = [struct.unpack(">d", bytes.fromhex(vn[k]))[0] for k in common]
+    sd = sorted(dists)
+    p99 = sd[min(max(int(0.99 * len(sd)), 1), len(sd)) - 1]
+    tau = _kendall_tau(fo, fn_)
+    rows = []
+    for name, val in (("numerics.max_ulp", max(dists)),
+                      ("numerics.p99_ulp", p99)):
+        rows.append({"row": name, "old": 0.0, "new": float(val),
+                     "delta_frac": float(val), "direction": "lower",
+                     "regressed": val > 0})
+    if tau is not None:
+        rows.append({"row": "numerics.rank_tau", "old": 1.0,
+                     "new": float(tau), "delta_frac": float(tau) - 1.0,
+                     "direction": "higher", "regressed": tau < 1.0})
+    if any(r["regressed"] for r in rows):
+        notes.append(f"numerics: v(S) DRIFTED on {sum(1 for d in dists if d)}"
+                     f"/{len(common)} subsets (max {max(dists)} ulp) — "
+                     "same-fingerprint runs must be bit-identical")
+    return rows
+
+
 def diff_sidecars(old: dict, new: dict, threshold: float) -> dict:
     """Compare two sidecar documents. Returns
     {rows: [...], regressions: [...], notes: [...], comparable: bool}.
@@ -134,6 +239,15 @@ def diff_sidecars(old: dict, new: dict, threshold: float) -> dict:
         out_rows.append(row)
         if regressed:
             regressions.append(row)
+    # the numerics (value-truth) gate rides beside the perf rows: bit
+    # drift between same-fingerprint runs is always a regression (the
+    # threshold does not soften correctness), but only when both sides
+    # carry the block AND the provenance comparison holds
+    for row in _numerics_rows(old, new, notes):
+        row["regressed"] = row["regressed"] and comparable
+        out_rows.append(row)
+        if row["regressed"]:
+            regressions.append(row)
     only_old = sorted(set(rows_old) - set(rows_new))
     only_new = sorted(set(rows_new) - set(rows_old))
     if only_old:
@@ -141,7 +255,7 @@ def diff_sidecars(old: dict, new: dict, threshold: float) -> dict:
     if only_new:
         notes.append(f"rows only in new (skipped): {only_new}")
     return {"rows": out_rows, "regressions": regressions, "notes": notes,
-            "comparable": comparable}
+            "comparable": comparable, "compared_rows": len(out_rows)}
 
 
 def format_diff(result: dict, label: str = "", threshold: float = 0.1
@@ -195,7 +309,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     try:
-        if os.path.isdir(args.old) and os.path.isdir(args.new):
+        dir_mode = os.path.isdir(args.old) and os.path.isdir(args.new)
+        if dir_mode:
             jobs = list(_pairs(args.old, args.new))
             if not jobs:
                 # a gate that compared NOTHING must not read as green —
@@ -207,12 +322,23 @@ def main(argv=None) -> int:
         else:
             jobs = [("", args.old, args.new)]
         regressed = False
+        compared_total = 0
         for label, p_old, p_new in jobs:
             result = diff_sidecars(_load(p_old), _load(p_new),
                                    args.threshold)
             print(format_diff(result, label or os.path.basename(p_new),
                               args.threshold))
             regressed = regressed or bool(result["regressions"])
+            compared_total += result.get("compared_rows", 0)
+        if dir_mode and not compared_total:
+            # name-matched pairs existed but every one of them diffed
+            # ZERO rows (schema-disjoint sidecars — e.g. a run dir whose
+            # files predate every tracked row): that is still a gate
+            # that compared nothing, distinct from pairs that legally
+            # skip a few newer rows (those still compare the rest)
+            print("[bench_diff] error: matched pairs shared no comparable "
+                  "rows — nothing was actually gated", file=sys.stderr)
+            return 2
     except (OSError, ValueError) as e:
         print(f"[bench_diff] error: {e}", file=sys.stderr)
         return 2

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Diff two value-provenance ledgers (obs/numerics.py, the numeric-truth
+plane's artifact): per-subset ulp-distance histogram, max/percentile
+drift, and the Kendall-tau of the induced v(S) ranking.
+
+A ledger records every harvested v(S) with its EXACT float bits plus the
+float path that produced it (topology, device count, reduction mode, slot
+width, OOM rungs), keyed by (subset bitmask, engine fingerprint). Diffing
+two ledgers answers the question the 2-D shard_map drift sat on for ten
+PRs: did two runs — different topologies, device counts, toolchains —
+compute the SAME game, bit for bit, and if not, by how much and does the
+drift flip the value ranking (the correctness stake per "On the
+Volatility of Shapley-Based Contribution Metrics", PAPERS.md).
+
+Usage:
+    python scripts/drift_diff.py A.json B.json [--json] [--gate]
+
+Exit codes: 0 = comparable and zero drift (or --gate not set and the
+ledgers merely differ), 1 = --gate set and drift detected, 2 = usage /
+unreadable ledger / fingerprint mismatch (different games are not drift
+— they are a comparison error) / zero common subsets (a gate that
+compared nothing must not read green).
+
+Same-seed self-test contract (tests/test_numerics.py): two ledgers from
+identical runs diff to zero drift, max_ulp 0, tau 1.0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def format_diff(res: dict, label_a: str, label_b: str) -> str:
+    lines = [f"ledger drift: {label_a} vs {label_b}"]
+    ma, mb = res.get("meta_a", {}), res.get("meta_b", {})
+    lines.append(
+        f"  float paths: a=({ma.get('topology')}, part={ma.get('part_shards')}, "
+        f"dev={ma.get('n_devices')}, {ma.get('reduction_mode')})  "
+        f"b=({mb.get('topology')}, part={mb.get('part_shards')}, "
+        f"dev={mb.get('n_devices')}, {mb.get('reduction_mode')})")
+    if not res["same_fingerprint"]:
+        lines.append("  ! engine fingerprints differ — these ledgers "
+                     "describe DIFFERENT GAMES, not drift")
+        return "\n".join(lines)
+    u = res["ulp"]
+    lines.append(
+        f"  subsets: common={res['common']}  only_a={res['only_a']}  "
+        f"only_b={res['only_b']}")
+    lines.append(
+        f"  ulp drift: max={u['max']}  p99={u['p99']}  p50={u['p50']}  "
+        f"nonzero={u['nonzero']}/{res['common']}")
+    if res["histogram"]:
+        buckets = sorted(res["histogram"].items(),
+                         key=lambda kv: (kv[0] != "0", kv[0]))
+        lines.append("  histogram: "
+                     + "  ".join(f"{k}:{v}" for k, v in buckets))
+    tau = res["kendall_tau"]
+    lines.append("  ranking kendall-tau: "
+                 + (f"{tau:.4f}" if tau is not None else "n/a"))
+    if not res["common"]:
+        lines.append("  NOTHING COMPARED — no common subsets")
+    elif not res["drift"]:
+        lines.append("  ZERO DRIFT — bit-identical values")
+    else:
+        lines.append("  DRIFT DETECTED")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two value-provenance ledgers "
+                    "(per-subset ulp drift + ranking tau).")
+    ap.add_argument("ledger_a")
+    ap.add_argument("ledger_b")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw diff dict as JSON")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 when any common subset's value bits "
+                         "differ")
+    args = ap.parse_args(argv)
+
+    from mplc_tpu.obs import numerics
+
+    try:
+        a = numerics.ValueLedger.load(args.ledger_a)
+        b = numerics.ValueLedger.load(args.ledger_b)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"[drift_diff] error: {e}", file=sys.stderr)
+        return 2
+    res = numerics.diff_ledgers(a, b)
+    if args.json:
+        print(json.dumps(res, indent=2))
+    else:
+        print(format_diff(res, args.ledger_a, args.ledger_b))
+    if not res["same_fingerprint"]:
+        print("[drift_diff] error: fingerprint mismatch — different "
+              "games cannot be drift-compared", file=sys.stderr)
+        return 2
+    if not res["common"]:
+        # same game but ZERO overlapping subsets: the diff compared
+        # nothing, and a gate that compared nothing must not read green
+        # (same invariant as bench_diff's dir-mode exit 2)
+        print("[drift_diff] error: ledgers share no common subsets — "
+              "nothing was compared", file=sys.stderr)
+        return 2
+    if args.gate and res["drift"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    sys.exit(main())

@@ -175,6 +175,10 @@ _NO_DONATION_ALLOWLIST = {
     ("mplc_tpu/mpl/engine.py", "MplTrainer.jit_batched_init"):
         "the rng batch is the only array input and the caller passes it "
         "again to the epoch chunk",
+    ("mplc_tpu/mpl/engine.py", "MplTrainer.jit_gen_streams"):
+        "the deterministic stream generator's inputs are the live rng "
+        "batch and the stacked mask, both reused by the chunk call "
+        "dispatched right after",
     ("mplc_tpu/contrib/engine.py", "_fold_bitmask_keys"):
         "inputs are tiny uint32 word arrays plus the engine's SHARED seed "
         "key, which every later batch folds again",

@@ -1,0 +1,502 @@
+"""Numeric-truth plane (obs/numerics.py): ledger, audit, drift tooling.
+
+Covers the PR's acceptance contracts: the ledger round-trips and is
+deterministic; audit mode NEVER perturbs v(S) (bit-identity audit-on vs
+audit-off, including under the PR-4 fault ladder's transient/OOM/CPU
+rungs); deterministic-reduce makes 1-device and N-device engines
+bit-identical; the audit localizes reduction-order divergence; and the
+drift tooling (scripts/drift_diff.py, scripts/bench_diff.py `numerics`
+gate) reports zero drift for same-seed runs, flags injected
+perturbations, and stays schema-compatible with pre-numerics sidecars.
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from helpers import build_scenario
+from mplc_tpu.contrib.engine import CharacteristicEngine
+from mplc_tpu.contrib.shapley import powerset_order
+from mplc_tpu.obs import numerics
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "scripts"))
+import bench_diff  # noqa: E402
+import drift_diff  # noqa: E402
+
+
+def _scenario(seed=9, partners=4):
+    amounts = {3: [0.2, 0.3, 0.5], 4: [0.1, 0.2, 0.3, 0.4]}[partners]
+    return build_scenario(partners_count=partners,
+                          amounts_per_partner=amounts,
+                          dataset_name="titanic", epoch_count=2,
+                          gradient_updates_per_pass_count=2, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# float forensics + ledger
+# ---------------------------------------------------------------------------
+
+def test_ulp_distance_basics():
+    assert numerics.ulp_distance(1.0, 1.0) == 0
+    assert numerics.ulp_distance(0.0, -0.0) == 0
+    assert numerics.ulp_distance(1.0, np.nextafter(1.0, 2.0)) == 1
+    assert numerics.ulp_distance(1.0, np.nextafter(1.0, 0.0)) == 1
+    a = np.float32([1.0, 2.0, -0.0])
+    b = np.float32([1.0, np.nextafter(np.float32(2.0), np.float32(3.0)), 0.0])
+    np.testing.assert_array_equal(numerics.ulp_distance_f32(a, b), [0, 1, 0])
+
+
+def test_float_bits_round_trip():
+    for v in (0.0, -0.0, 1.0, -1.5, 0.1, 3.14159e-30, float("inf")):
+        bits = numerics.float_bits(v)
+        assert len(bits) == 16
+        back = numerics.bits_to_float(bits)
+        assert (back == v) or (np.isnan(back) and np.isnan(v))
+
+
+def test_ledger_round_trip_and_determinism(tmp_path):
+    def build(path):
+        led = numerics.ValueLedger("fp123", {"topology": "1d",
+                                             "part_shards": 1,
+                                             "n_devices": 8,
+                                             "reduction_mode": "default"},
+                                   path=str(path))
+        led.record((0, 1), 0.75, source="exact", slot_width=2)
+        led.record((2,), 0.5, source="exact", slot_width=None,
+                   cap_halvings=1, degraded=True)
+        led.save()
+        return led
+
+    a = build(tmp_path / "a.json")
+    b = build(tmp_path / "b.json")
+    # determinism: identical inputs produce identical documents
+    assert a.to_doc() == b.to_doc()
+    # content hashes present and stable
+    assert all(len(e["content_hash"]) == 16 for e in a.entries.values())
+    # round trip through disk
+    loaded = numerics.ValueLedger.load(str(tmp_path / "a.json"))
+    assert loaded.to_doc()["entries"] == a.to_doc()["entries"]
+    assert loaded.engine_fingerprint == "fp123"
+    # subset keys are bitmask hex, order-insensitive
+    assert numerics.ValueLedger.subset_key((1, 0)) == \
+        numerics.ValueLedger.subset_key((0, 1)) == hex(0b11)
+
+
+def test_kendall_tau_b_matches_bruteforce_and_scales():
+    """The O(n log n) Knight tau-b must agree with the O(n^2) definition
+    (ties included) and stay fast at full-ledger scale (2^16 subsets)."""
+    def brute(a, b):
+        n = len(a)
+        conc = disc = ta = tb = 0
+        for i in range(n):
+            for j in range(i + 1, n):
+                da, db = a[i] - a[j], b[i] - b[j]
+                if da == 0 and db == 0:
+                    continue
+                if da == 0:
+                    ta += 1
+                elif db == 0:
+                    tb += 1
+                elif da * db > 0:
+                    conc += 1
+                else:
+                    disc += 1
+        d = ((conc + disc + ta) * (conc + disc + tb)) ** 0.5
+        return (conc - disc) / d if d else None
+
+    rng = np.random.default_rng(5)
+    for _ in range(20):
+        n = int(rng.integers(2, 40))
+        # heavy ties: values drawn from a tiny alphabet
+        a = list(rng.integers(0, 5, n).astype(float))
+        b = list(rng.integers(0, 5, n).astype(float))
+        got, want = numerics.kendall_tau_b(a, b), brute(a, b)
+        if want is None:
+            assert got is None
+        else:
+            assert got == pytest.approx(want, abs=1e-12), (a, b)
+    # identical lists with ties: exactly 1.0
+    a = list(rng.uniform(size=30)) + [0.5, 0.5, 0.5]
+    assert numerics.kendall_tau_b(a, a) == 1.0
+    # full-ledger scale: 2^16 pairs must finish in seconds, not hours
+    big = rng.uniform(size=65536)
+    t0 = time.perf_counter()
+    tau = numerics.kendall_tau_b(big, big + rng.normal(0, 1e-3, 65536))
+    assert time.perf_counter() - t0 < 10.0
+    assert tau is not None and 0.0 < tau <= 1.0
+
+
+def test_ledger_hashing_is_cheap():
+    """The <5% host-overhead acceptance at ledger scale: recording 5000
+    values (3x the full 10-partner sweep with margin) must take well
+    under a second of host time — the per-value cost is one small json
+    dump + sha256."""
+    led = numerics.ValueLedger("fp", {"reduction_mode": "default"})
+    t0 = time.perf_counter()
+    for i in range(5000):
+        led.record((i % 31,), 0.5 + i * 1e-6)
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"ledger hashing took {dt:.2f}s for 5000 records"
+
+
+def test_diff_ledgers_zero_and_perturbed():
+    base = numerics.ValueLedger("fp", {"reduction_mode": "default"})
+    pert = numerics.ValueLedger("fp", {"reduction_mode": "default"})
+    rng = np.random.default_rng(0)
+    subsets = [tuple(sorted(s)) for s in
+               [(0,), (1,), (2,), (0, 1), (0, 2), (1, 2), (0, 1, 2)]]
+    for s in subsets:
+        v = float(rng.uniform(0.5, 0.9))
+        base.record(s, v)
+        # deliberately perturb every coalition containing partner 1
+        pert.record(s, np.nextafter(v, 2.0) if 1 in s else v)
+    same = numerics.diff_ledgers(base, base)
+    assert not same["drift"] and same["ulp"]["max"] == 0
+    assert same["kendall_tau"] == 1.0
+
+    d = numerics.diff_ledgers(base, pert)
+    assert d["drift"] and d["ulp"]["max"] == 1
+    drifted = {k for k, u in d["per_subset"].items() if u}
+    expected = {numerics.ValueLedger.subset_key(s) for s in subsets
+                if 1 in s}
+    # drift localization: exactly the perturbed partner's coalitions moved
+    assert drifted == expected
+
+    other = numerics.ValueLedger("DIFFERENT", {})
+    other.record((0,), 0.5)
+    dd = numerics.diff_ledgers(base, other)
+    assert not dd["same_fingerprint"] and not dd["comparable"]
+
+
+# ---------------------------------------------------------------------------
+# audit never perturbs results
+# ---------------------------------------------------------------------------
+
+def test_audit_on_off_bit_identity(monkeypatch):
+    subsets = powerset_order(3)
+    monkeypatch.delenv("MPLC_TPU_NUMERICS_AUDIT", raising=False)
+    monkeypatch.delenv("MPLC_TPU_DEVICE_FENCE_RATE", raising=False)
+    ref = CharacteristicEngine(_scenario(partners=3)).evaluate(subsets)
+
+    monkeypatch.setenv("MPLC_TPU_NUMERICS_AUDIT", "1")
+    monkeypatch.setenv("MPLC_TPU_DEVICE_FENCE_RATE", "1")  # fence (and
+    # therefore audit-sample) every batch — the strictest setting
+    eng = CharacteristicEngine(_scenario(partners=3))
+    vals = eng.evaluate(subsets)
+    np.testing.assert_array_equal(vals, ref)
+    # the audit genuinely ran (multis batches were fenced) and localized
+    # the default-order grouping divergence with real evidence
+    assert eng.numerics_audits, "no audit ran despite fence rate 1"
+    res = eng.numerics_audits[0]
+    assert res.rounds > 0 and res.shard_counts
+
+
+def test_audit_bit_identity_across_fault_ladder(monkeypatch, tmp_path):
+    """transient retry + OOM cap-halving + the terminal CPU rung, with
+    the audit sampling fenced batches throughout: v(S) must equal the
+    fault-free, audit-free sweep bit for bit."""
+    subsets = powerset_order(3)
+    monkeypatch.delenv("MPLC_TPU_NUMERICS_AUDIT", raising=False)
+    monkeypatch.delenv("MPLC_TPU_FAULT_PLAN", raising=False)
+    ref = CharacteristicEngine(_scenario(partners=3)).evaluate(subsets)
+
+    monkeypatch.setenv("MPLC_TPU_NUMERICS_AUDIT", "1")
+    monkeypatch.setenv("MPLC_TPU_DEVICE_FENCE_RATE", "1")
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN",
+                       "transient@batch1,oom@batch2")
+    eng = CharacteristicEngine(_scenario(partners=3))
+    vals = eng.evaluate(subsets)
+    np.testing.assert_array_equal(vals, ref)
+    assert eng._cap_halvings >= 1  # the ladder really moved
+
+    # exhaust the ladder into the CPU rung, audit still on
+    monkeypatch.setenv("MPLC_TPU_MAX_CAP_HALVINGS", "1")
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", "oom@batch1,oom@batch2")
+    eng2 = CharacteristicEngine(_scenario(partners=3))
+    vals2 = eng2.evaluate(subsets)
+    np.testing.assert_array_equal(vals2, ref)
+    assert eng2._cpu_degraded
+
+
+def test_ledger_never_perturbs_and_persists(monkeypatch, tmp_path):
+    subsets = powerset_order(3)
+    monkeypatch.delenv("MPLC_TPU_NUMERICS_LEDGER", raising=False)
+    ref = CharacteristicEngine(_scenario(partners=3)).evaluate(subsets)
+    path = tmp_path / "ledger.json"
+    monkeypatch.setenv("MPLC_TPU_NUMERICS_LEDGER", str(path))
+    eng = CharacteristicEngine(_scenario(partners=3))
+    vals = eng.evaluate(subsets)
+    np.testing.assert_array_equal(vals, ref)
+    led = numerics.ValueLedger.load(str(path))
+    assert len(led.entries) == len(subsets)
+    # the recorded bits ARE the served values
+    for s in subsets:
+        bits = led.entries[numerics.ValueLedger.subset_key(s)]["value_bits"]
+        assert numerics.bits_to_float(bits) == eng.charac_fct_values[s]
+
+
+# ---------------------------------------------------------------------------
+# deterministic-reduce equality + audit verification of the pinned order
+# ---------------------------------------------------------------------------
+
+def test_deterministic_reduce_1_vs_n_devices(monkeypatch, tmp_path):
+    """The retired-xfail contract at engine level: deterministic part=1
+    (unsharded reference) == part=2 == part=4, bit for bit, through the
+    full evaluate() stack (memo, buckets, sliced singles) — and the
+    value ledgers of the different TOPOLOGIES drift-diff to zero (the
+    cross-topology run of the acceptance's same-seed zero-drift
+    contract, via the real scripts/drift_diff.py entry point)."""
+    subsets = powerset_order(4)
+    monkeypatch.setenv("MPLC_TPU_DETERMINISTIC_REDUCE", "1")
+    monkeypatch.setenv("MPLC_TPU_NUMERICS_LEDGER",
+                       str(tmp_path / "led1.json"))
+    monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
+    ref = CharacteristicEngine(_scenario()).evaluate(subsets)
+    for shards in ("2", "4"):
+        monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", shards)
+        monkeypatch.setenv("MPLC_TPU_NUMERICS_LEDGER",
+                           str(tmp_path / f"led{shards}.json"))
+        vals = CharacteristicEngine(_scenario()).evaluate(subsets)
+        np.testing.assert_array_equal(vals, ref)
+        assert drift_diff.main([str(tmp_path / "led1.json"),
+                                str(tmp_path / f"led{shards}.json"),
+                                "--gate"]) == 0
+
+
+def test_hoisted_streams_respect_resumed_epochs(monkeypatch):
+    """The hoisted deterministic streams must follow the SAME rule as
+    the in-program generation for a chunk resumed at epoch e > 0 (the
+    PVRL pattern: repeated n_epochs=1 chunks on a live state): chunk
+    rng folded by POSITION, then by state.epoch — not by position
+    twice. A generator that assumed epoch == position would hand a
+    resumed chunk epoch-0 permutations."""
+    import jax
+    import jax.numpy as jnp
+
+    from mplc_tpu.models import TITANIC_LOGREG
+    from mplc_tpu.mpl.engine import MplTrainer, TrainConfig
+
+    monkeypatch.setenv("MPLC_TPU_DETERMINISTIC_REDUCE", "1")
+    cfg = TrainConfig(approach="fedavg", epoch_count=4, minibatch_count=2,
+                      gradient_updates_per_pass=2, is_early_stopping=False,
+                      record_partner_val=False, record_val_history=False)
+    tr = MplTrainer(TITANIC_LOGREG, cfg)
+    assert tr._det_hoist_streams()
+    rng = jax.random.PRNGKey(3)
+    mask = jnp.ones((4, 16), jnp.float32)
+    for e in (0, 2):
+        perms, keys = tr.gen_epoch_streams(rng, mask,
+                                           jnp.int32(e), n_epochs=1)
+        # the in-program rule for chunk position 0 at state.epoch == e:
+        re = jax.random.fold_in(jax.random.fold_in(rng, 0), e)
+        want_perms = tr._epoch_perms(jax.random.fold_in(re, 0), mask)
+        np.testing.assert_array_equal(np.asarray(perms[0]),
+                                      np.asarray(want_perms))
+        rng_mb = jax.random.fold_in(jax.random.fold_in(re, 1), 1)
+        want_keys = jax.vmap(lambda p: jax.random.fold_in(rng_mb, p))(
+            jnp.arange(4, dtype=jnp.int32))
+        np.testing.assert_array_equal(np.asarray(keys[0, 1]),
+                                      np.asarray(want_keys))
+    # and the e=2 streams genuinely differ from e=0's (the old bug
+    # handed every resumed chunk the epoch-0 streams)
+    p0, _ = tr.gen_epoch_streams(rng, mask, jnp.int32(0), n_epochs=1)
+    p2, _ = tr.gen_epoch_streams(rng, mask, jnp.int32(2), n_epochs=1)
+    assert not np.array_equal(np.asarray(p0), np.asarray(p2))
+
+
+def test_deterministic_reduce_is_fingerprinted(monkeypatch, tmp_path):
+    """A cache written under the default reduction describes a different
+    game than a deterministic-mode engine computes — loading it must
+    refuse with the fingerprint error, not silently mix orders."""
+    monkeypatch.delenv("MPLC_TPU_DETERMINISTIC_REDUCE", raising=False)
+    eng = CharacteristicEngine(_scenario(partners=3))
+    eng.evaluate(powerset_order(3))
+    path = tmp_path / "cache.json"
+    eng.save_cache(path)
+    monkeypatch.setenv("MPLC_TPU_DETERMINISTIC_REDUCE", "1")
+    det = CharacteristicEngine(_scenario(partners=3))
+    with pytest.raises(ValueError, match="deterministic_reduce"):
+        det.load_cache(path)
+
+
+def test_audit_verifies_pinned_order_under_det(monkeypatch):
+    """Under deterministic-reduce the audit must find ZERO executed-order
+    divergence at ANY shard count — the executed fold IS the linear
+    reference order — while its hypothetical grouping table still
+    quantifies what a psum order would have done (the evidence value).
+    A default-mode 2-D engine, by contrast, EXECUTES the grouped order:
+    the audit localizes a first divergent (round, leaf, shards) with
+    nonzero ulp — the root-cause evidence that retired the xfails."""
+    monkeypatch.setenv("MPLC_TPU_DETERMINISTIC_REDUCE", "1")
+    monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "2")
+    eng = CharacteristicEngine(_scenario())
+    res = numerics.audit_coalition(eng, (0, 1, 2, 3))
+    assert res is not None
+    assert res.executed_shards is None  # det executes the linear order
+    assert res.first_divergence is None and res.max_ulp == 0
+    # the hypothetical table still shows the order sensitivity det pins
+    assert max(res.ulp_by_shards.values()) > 0
+
+    monkeypatch.delenv("MPLC_TPU_DETERMINISTIC_REDUCE", raising=False)
+    deng = CharacteristicEngine(_scenario())
+    assert deng._pipe2d is not None and deng._pipe2d.part_shards == 2
+    dres = numerics.audit_coalition(deng, (0, 1, 2, 3))
+    assert dres is not None and dres.executed_shards == 2
+    assert dres.first_divergence is not None and dres.max_ulp > 0
+    r, leaf, shards = dres.first_divergence
+    assert 0 <= r < dres.rounds and shards == 2
+    assert dres.partials_at_divergence is not None
+
+
+def test_audit_drift_dump_rides_flight_recorder(monkeypatch, tmp_path):
+    """A localized executed-order divergence must land a postmortem
+    through obs/flight.py carrying the divergent leaf and per-device
+    partials (the conftest fixture routes dumps into tmp)."""
+    monkeypatch.delenv("MPLC_TPU_DETERMINISTIC_REDUCE", raising=False)
+    monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "2")
+    eng = CharacteristicEngine(_scenario())
+    res = numerics.audit_coalition(eng, (0, 1, 2, 3))
+    assert res is not None and res.first_divergence is not None
+    import os
+    flight_dir = os.environ["MPLC_TPU_FLIGHT_RECORDER_DIR"]
+    dumps = [p for p in Path(flight_dir).glob("mplc_flight_numerics_drift_*")]
+    assert dumps, "numerics.drift produced no flight-recorder postmortem"
+    doc = json.loads(dumps[-1].read_text())
+    assert doc["extra"]["divergent_leaf"] == res.first_divergence[1]
+    assert doc["extra"]["per_device_partials"] is not None
+
+
+# ---------------------------------------------------------------------------
+# drift_diff / bench_diff tooling
+# ---------------------------------------------------------------------------
+
+def _mini_ledgers(tmp_path, perturb: bool):
+    a = numerics.ValueLedger("fpX", {"reduction_mode": "default"},
+                             path=str(tmp_path / "a.json"))
+    b = numerics.ValueLedger("fpX", {"reduction_mode": "default"},
+                             path=str(tmp_path / "b.json"))
+    for i, s in enumerate([(0,), (1,), (0, 1)]):
+        v = 0.6 + i * 0.05
+        a.record(s, v)
+        b.record(s, np.nextafter(v, 1e9) if perturb and i == 1 else v)
+    a.save()
+    b.save()
+    return str(tmp_path / "a.json"), str(tmp_path / "b.json")
+
+
+def test_drift_diff_same_seed_zero(tmp_path, capsys):
+    pa, pb = _mini_ledgers(tmp_path, perturb=False)
+    assert drift_diff.main([pa, pb, "--gate"]) == 0
+    out = capsys.readouterr().out
+    assert "ZERO DRIFT" in out
+
+
+def test_drift_diff_gates_perturbation(tmp_path, capsys):
+    pa, pb = _mini_ledgers(tmp_path, perturb=True)
+    assert drift_diff.main([pa, pb, "--gate"]) == 1
+    assert "DRIFT DETECTED" in capsys.readouterr().out
+
+
+def test_drift_diff_refuses_fingerprint_mismatch(tmp_path):
+    pa, _ = _mini_ledgers(tmp_path, perturb=False)
+    other = numerics.ValueLedger("OTHER", {}, path=str(tmp_path / "o.json"))
+    other.record((0,), 0.5)
+    other.save()
+    assert drift_diff.main([pa, str(tmp_path / "o.json")]) == 2
+
+
+def _sidecar(values: dict, fingerprint="fpX") -> dict:
+    return {"wallclock_s": 10.0, "source": "fresh",
+            "report": {"wallclock": {"evaluate_s": 9.0}},
+            "numerics": {"engine_fingerprint": fingerprint,
+                         "reduction_mode": "deterministic",
+                         "values": {k: numerics.float_bits(v)
+                                    for k, v in values.items()}}}
+
+
+def test_bench_diff_numerics_gate_flags_perturbation():
+    base = {"0x1": 0.7, "0x2": 0.72, "0x3": 0.8}
+    res = bench_diff.diff_sidecars(_sidecar(base), _sidecar(base), 0.10)
+    assert not res["regressions"]
+    rows = {r["row"]: r for r in res["rows"]}
+    assert rows["numerics.max_ulp"]["new"] == 0
+    assert rows["numerics.rank_tau"]["new"] == 1.0
+
+    pert = dict(base, **{"0x2": float(np.nextafter(0.72, 2.0))})
+    res = bench_diff.diff_sidecars(_sidecar(base), _sidecar(pert), 0.10)
+    assert any(r["row"] == "numerics.max_ulp" and r["regressed"]
+               for r in res["regressions"])
+
+
+def test_bench_diff_numerics_skips_different_games():
+    base = {"0x1": 0.7}
+    res = bench_diff.diff_sidecars(_sidecar(base),
+                                   _sidecar(base, fingerprint="OTHER"),
+                                   0.10)
+    assert not any(r["row"].startswith("numerics") for r in res["rows"])
+    assert any("different games" in n for n in res["notes"])
+
+
+def test_bench_diff_schema_compat_pre_numerics_sidecars():
+    """A sidecar that predates the numerics block (every r1-r5 artifact)
+    must diff cleanly: no numerics rows, no crash, other rows compared."""
+    old = {"wallclock_s": 10.0, "source": "fresh",
+           "report": {"wallclock": {"evaluate_s": 9.0, "compile_s": 1.0,
+                                    "prep_s": 0.1, "dispatch_s": 0.5,
+                                    "harvest_s": 0.2}}}
+    new = dict(old, numerics={"engine_fingerprint": "fpX",
+                              "values": {"0x1": numerics.float_bits(0.7)}})
+    res = bench_diff.diff_sidecars(old, new, 0.10)
+    assert not any(r["row"].startswith("numerics") for r in res["rows"])
+    assert res["compared_rows"] > 0
+    assert not res["regressions"]
+
+
+def test_bench_diff_dir_mode_exit2_only_when_nothing_comparable(tmp_path):
+    """Dir mode: pairs that merely SKIP newer rows still gate the rest
+    (exit 0), while pairs sharing NO rows at all exit 2 — a gate that
+    compared nothing must not read green."""
+    old_dir, new_dir = tmp_path / "old", tmp_path / "new"
+    old_dir.mkdir()
+    new_dir.mkdir()
+    doc = {"wallclock_s": 10.0, "source": "fresh",
+           "report": {"wallclock": {"evaluate_s": 9.0}}}
+    (old_dir / "telemetry_config1.json").write_text(json.dumps(doc))
+    (new_dir / "telemetry_config1.json").write_text(json.dumps(doc))
+    assert bench_diff.main([str(old_dir), str(new_dir)]) == 0
+
+    # schema-disjoint pair: nothing comparable anywhere -> exit 2
+    (old_dir / "telemetry_config1.json").write_text(json.dumps(
+        {"something_else": 1}))
+    assert bench_diff.main([str(old_dir), str(new_dir)]) == 2
+
+
+def test_report_numerics_row_formats(monkeypatch, tmp_path):
+    """sweep_report + format_report carry the numerics row when the
+    stream has audit/ledger events — and old record streams keep the
+    exact old schema (no row)."""
+    from mplc_tpu.obs import trace as obs_trace
+    from mplc_tpu.obs.report import format_report, sweep_report
+
+    with obs_trace.collect() as rec:
+        obs_trace.event("numerics.audit", subset="0xf", rounds=4,
+                        shard_counts=[2], max_ulp=32, first_round=0,
+                        first_leaf="d1/b", reduction_mode="default",
+                        divergent_elements=3)
+        obs_trace.event("numerics.drift", subset="0xf", round=0,
+                        leaf="d1/b", shards=2, max_ulp=32)
+        obs_trace.event("numerics.ledger", path="x.json", entries=15,
+                        reduction_mode="default")
+    rep = sweep_report(rec)
+    nm = rep["numerics"]
+    assert nm["audits"] == 1 and nm["drift_events"] == 1
+    assert nm["max_ulp"] == 32 and nm["ledger_entries"] == 15
+    txt = format_report(rep)
+    assert "numerics" in txt and "max_ulp=32" in txt
+
+    assert "numerics" not in sweep_report([])

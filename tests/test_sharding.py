@@ -185,25 +185,27 @@ def test_es_noop_skip_is_numerically_identical():
     np.testing.assert_array_equal(fast, slow)
 
 
-# Known failures of the 2-D [coal x part] shard_map mode on the current
-# jax_graft build (tracked in DESIGN_NOTES.md "2-D shard_map numeric
-# drift"): the partner-sharded engine drifts numerically from the 1-D
-# reference past any justifiable tolerance, and XLA now emits an extra
-# whole-mesh all-reduce the collective-budget lock forbids. strict=False:
-# a toolchain that restores agreement turns these back green silently.
-_SHARD_MAP_DRIFT = pytest.mark.xfail(
-    strict=False,
-    reason="2-D shard_map drift / collective-lowering change on current "
-           "jax_graft toolchain (DESIGN_NOTES.md)")
+# From PR 3 to PR 13 the four tests below were xfail(strict=False): the
+# 2-D [coal x part] path drifted numerically past any justifiable
+# tolerance and the collective-budget lock caught an unexplained
+# whole-mesh all-reduce. The numeric-truth plane (obs/numerics.py)
+# root-caused all of it — psum grouping order + in-program stream
+# generation beside a collective + per-topology loop-body compilation,
+# with the whole-mesh all-reduce attributed to the epoch-permutation
+# tensors — and MPLC_TPU_DETERMINISTIC_REDUCE=1 eliminates every source:
+# the tests now assert BIT-identity, unconditionally. Full evidence in
+# DESIGN_NOTES.md "2-D shard_map numeric drift — closed".
 
 
-@_SHARD_MAP_DRIFT
 def test_engine_2d_partner_sharded_matches_default(monkeypatch):
-    """MPLC_TPU_PARTNER_SHARDS=2 runs multis on a [4 coal x 2 part] mesh
-    (masked path, partner dimension split inside each coalition training,
-    psum aggregation). Global-index rng keying makes it train the same
-    trajectories — the full 4-partner v(S) table must match the default
-    engine (slot execution, 1-D coal mesh) to float tolerance."""
+    """Under deterministic-reduce, MPLC_TPU_PARTNER_SHARDS=2 runs multis
+    on a [4 coal x 2 part] mesh (masked path, ordered-fold aggregation
+    over all-gathered terms). The full 4-partner v(S) table must be
+    BIT-IDENTICAL to the deterministic unsharded engine (part=1: whole
+    partner axis resident per device) — and the deterministic values
+    must still match the default slot-execution engine to the historical
+    float tolerance, so the pinned order stays anchored to the same
+    game."""
     from helpers import build_scenario
     from mplc_tpu.contrib.engine import CharacteristicEngine
     from mplc_tpu.contrib.shapley import powerset_order
@@ -215,17 +217,31 @@ def test_engine_2d_partner_sharded_matches_default(monkeypatch):
                               gradient_updates_per_pass_count=2, seed=9)
 
     subsets = powerset_order(4)
-    # the reference engine must be genuinely 1-D even if the ambient env
-    # pre-set the knob — otherwise this compares the 2-D path to itself
+    # the default-mode engine must be genuinely 1-D even if the ambient
+    # env pre-set the knobs
     monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
-    ref_vals = CharacteristicEngine(scenario()).evaluate(subsets)
+    monkeypatch.delenv("MPLC_TPU_DETERMINISTIC_REDUCE", raising=False)
+    default_vals = CharacteristicEngine(scenario()).evaluate(subsets)
+
+    monkeypatch.setenv("MPLC_TPU_DETERMINISTIC_REDUCE", "1")
+    ref_eng = CharacteristicEngine(scenario())
+    # deterministic mode routes the masked path through the 2-D-family
+    # pipeline with part=1 — the unsharded reference program
+    assert ref_eng._pipe2d is not None and ref_eng._pipe2d.part_shards == 1
+    assert ref_eng.scenario.slot_bucketing == "masked"
+    ref_vals = ref_eng.evaluate(subsets)
 
     monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "2")
     eng = CharacteristicEngine(scenario())
     assert eng._pipe2d is not None and eng._pipe2d.part_shards == 2
     assert eng._pipe2d.coal_devices == 4
     vals = eng.evaluate(subsets)
-    np.testing.assert_allclose(vals, ref_vals, atol=1e-4)
+    # the retired-xfail lock: partner-sharded == unsharded, bit for bit
+    np.testing.assert_array_equal(vals, ref_vals)
+    # anchored to the default engine's game at the historical tolerance
+    np.testing.assert_allclose(ref_vals, default_vals, atol=1e-4)
+    # the characteristic values must discriminate, or equality is vacuous
+    assert ref_vals.max() - ref_vals.min() > 1e-3
 
     # indivisible shard counts fail fast, not silently fall back
     monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "3")
@@ -294,11 +310,11 @@ def test_engine_2d_mode_via_scenario_param(monkeypatch):
     assert sc2.partner_shards == 1  # effective mode, not the ignored param
 
 
-@_SHARD_MAP_DRIFT
 def test_engine_2d_lflip_matches_default(monkeypatch):
     """The 2-D pipeline's lflip state specs (theta [B,P,K,K] and theta_h
     [B,E,P,K,K] sharded over coal+part) only exist under lflip — the
-    fedavg parity test never exercises them. Same equality contract."""
+    fedavg parity test never exercises them. Same retired-xfail contract:
+    BIT-identity between the deterministic part=2 and part=1 engines."""
     from helpers import build_scenario, cluster_mlp_dataset
     from mplc_tpu.contrib.engine import CharacteristicEngine
     from mplc_tpu.contrib.shapley import powerset_order
@@ -313,7 +329,10 @@ def test_engine_2d_lflip_matches_default(monkeypatch):
 
     subsets = powerset_order(4)
     monkeypatch.delenv("MPLC_TPU_PARTNER_SHARDS", raising=False)
-    ref_vals = CharacteristicEngine(scenario()).evaluate(subsets)
+    monkeypatch.setenv("MPLC_TPU_DETERMINISTIC_REDUCE", "1")
+    ref_eng = CharacteristicEngine(scenario())
+    assert ref_eng._pipe2d is not None and ref_eng._pipe2d.part_shards == 1
+    ref_vals = ref_eng.evaluate(subsets)
     # the characteristic values must discriminate, or parity is vacuous
     assert ref_vals.max() - ref_vals.min() > 1e-3
 
@@ -322,7 +341,7 @@ def test_engine_2d_lflip_matches_default(monkeypatch):
     assert eng._pipe2d is not None
     assert eng._pipe2d.trainer.cfg.approach == "lflip"
     vals = eng.evaluate(subsets)
-    np.testing.assert_allclose(vals, ref_vals, atol=1e-4)
+    np.testing.assert_array_equal(vals, ref_vals)
 
 
 def test_autosave_checkpoints_every_batch(tmp_path, monkeypatch):
@@ -407,24 +426,30 @@ def test_full_ten_partner_sweep_sharded():
     assert np.isclose(sv.sum(), grand, atol=1e-5)
 
 
-@_SHARD_MAP_DRIFT
 def test_2d_partner_sharded_hlo_collective_budget(monkeypatch):
-    """Compiler-level lock on the 2-D [coal x part] path's communication
-    budget (the partner-sharded analogue of the zero-collective coal-axis
-    lock above): the epoch-chunk program may communicate ONLY via
-    all-reduce (the per-aggregation psum over `part` —
-    parallel/partner_shard.py), every all-reduce must ride the part axis
-    alone (replica groups of size part_shards, never the whole mesh), no
-    other collective kind may appear, and the static all-reduce count must
-    stay small (one fused psum per aggregation site, not one per training
-    step or per parameter). A regression that all-gathers the stacked
-    data, psums over `coal`, or aggregates per-step would trip one of
-    these three asserts by name."""
+    """Compiler-level lock on the deterministic 2-D [coal x part] path's
+    communication budget, RE-DERIVED by the numeric-truth plane (the
+    fifth retired drift xfail): under MPLC_TPU_DETERMINISTIC_REDUCE the
+    epoch chunk communicates ONLY via all-gather (the ordered fold
+    gathers the weighted terms and the raw weight vector over `part` —
+    ops/aggregation.py), every gather must ride the part axis alone
+    (replica groups of size part_shards, never the whole mesh), and the
+    static site count is exactly rounds x (param leaves + 1 weight
+    gather) for the unrolled loops — bounded with headroom below.
+
+    The old default-mode lock xfailed on an unexplained whole-mesh
+    all-reduce; the audit attributed it to the IN-PROGRAM epoch-
+    permutation tensors (GSPMD reshards the [P_local, Nmax] perm/key
+    arrays across the whole mesh), and stream hoisting removes those
+    tensors from the program entirely — asserted here by the zero
+    all-reduce count. Evidence: DESIGN_NOTES.md "2-D shard_map numeric
+    drift — closed"."""
     import re
 
     from helpers import build_scenario
     from mplc_tpu.contrib.engine import CharacteristicEngine
 
+    monkeypatch.setenv("MPLC_TPU_DETERMINISTIC_REDUCE", "1")
     monkeypatch.setenv("MPLC_TPU_PARTNER_SHARDS", "2")
     eng = CharacteristicEngine(build_scenario(
         partners_count=4, amounts_per_partner=[0.1, 0.2, 0.3, 0.4],
@@ -445,47 +470,52 @@ def test_2d_partner_sharded_hlo_collective_budget(monkeypatch):
     state = pipe._init(rngs, P_count)
     n = pipe.trainer.cfg.epoch_count
     pipe._run(state, eng.stacked, eng.val, coal, rngs, n)  # populate cache
+    streams = pipe.trainer.jit_gen_streams(rngs, n, eng.stacked.mask,
+                                           batched=True)
+    state = pipe._init(rngs, P_count)
     hlo = pipe._run_cache[n].lower(
-        state, eng.stacked, eng.val, coal, rngs).compile().as_text()
+        state, eng.stacked, eng.val, coal, rngs, streams).compile().as_text()
 
-    forbidden = [op for op in _collectives_in(hlo) if op != "all-reduce"]
+    forbidden = [op for op in _collectives_in(hlo) if op != "all-gather"]
     assert not forbidden, (
-        f"2-D epoch-chunk program now contains {forbidden}; the "
-        "partner-sharded path must communicate via psum/all-reduce only")
+        f"deterministic 2-D epoch-chunk program now contains {forbidden}; "
+        "the ordered-fold path must communicate via all-gather only — an "
+        "all-reduce reappearing means either the psum came back or the "
+        "partitioner is resharding in-program tensors again")
 
-    ar_lines = [ln for ln in hlo.splitlines() if "all-reduce" in ln
+    ag_lines = [ln for ln in hlo.splitlines() if "all-gather" in ln
                 and "replica_groups" in ln]
-    assert ar_lines, "partner aggregation no longer produces any all-reduce"
+    assert ag_lines, "partner aggregation no longer produces any all-gather"
 
     group_sizes = set()
-    for ln in ar_lines:
+    for ln in ag_lines:
         m = re.search(r"replica_groups=\{\{([^}]*)\}", ln)
         if m:  # explicit list form: {{0,1},{2,3},...} — first group
             group_sizes.add(len(m.group(1).split(",")))
             continue
-        # plain iota form: [n_groups, group_size] <= [n_devices] — the
-        # transposed form ([a,b]<=[c,d]T(...)) has two dims after <= and
-        # deliberately does NOT match; it falls through to the hard fail
+        # plain iota form: [n_groups, group_size] <= [n_devices]
         m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=\[(\d+)\]", ln)
         if m:
             group_sizes.add(int(m.group(2)))
             continue
-        # any other form (e.g. the transposed iota XLA uses for groups
-        # along the major mesh axis) must fail the lock loudly, not
-        # slip past it unparsed
+        # any other form must fail the lock loudly, not slip past it
         raise AssertionError(f"unrecognized replica_groups format in: {ln}")
     assert group_sizes == {pipe.part_shards}, (
-        f"all-reduce replica groups {group_sizes} != part axis width "
+        f"all-gather replica groups {group_sizes} != part axis width "
         f"{pipe.part_shards}: a collective is riding more than `part`")
 
-    # Measured budget: XLA emits exactly 2 static all-reduce sites for this
-    # program (one tuple-fused params aggregation + one scalar psum),
-    # reused across loop iterations via channel ids — NOT one per training
-    # step. 8 leaves headroom for metric additions; a per-step or per-leaf
-    # blowup lands far above it.
-    assert len(ar_lines) <= 8, (
-        f"{len(ar_lines)} all-reduces in one epoch chunk — the aggregation "
-        "psum is no longer fused/hoisted as budgeted")
+    # Measured budget: the unrolled deterministic program emits one
+    # weight gather + one gather per param leaf per aggregation round —
+    # epochs x minibatches x (leaves + 1) = 2 x 2 x 3 = 12 for the
+    # titanic logreg. 2x headroom below; a per-step or per-device blowup
+    # lands far above it.
+    cfg = pipe.trainer.cfg
+    rounds = cfg.epoch_count * cfg.minibatch_count
+    n_leaves = len(jax.tree_util.tree_leaves(state.params))
+    assert len(ag_lines) <= 2 * rounds * (n_leaves + 1), (
+        f"{len(ag_lines)} all-gathers in one epoch chunk — the "
+        "deterministic fold's gather count is no longer one per "
+        "aggregation site")
 
 
 def test_pipeline_batches_matches_default(monkeypatch):
