@@ -348,6 +348,29 @@ DETERMINISTIC_REDUCE_ENV = "MPLC_TPU_DETERMINISTIC_REDUCE"
 NUMERICS_AUDIT_ENV = "MPLC_TPU_NUMERICS_AUDIT"
 NUMERICS_LEDGER_ENV = "MPLC_TPU_NUMERICS_LEDGER"
 
+# Fleet sweep plane (mplc_tpu/parallel/fleet.py): coalition-axis
+# sharding of one sweep across OS processes/hosts, merged with a
+# ledger-verified equality proof:
+#   MPLC_TPU_FLEET_SHARDS     caps the fleet bench's (BENCH_CONFIG=9)
+#                             deterministic EQUALITY-pass shard count
+#                             (effective default 4, further capped by
+#                             the largest BENCH_FLEET_DEVICES point);
+#                             the scaling-curve points' shard counts
+#                             come from BENCH_FLEET_DEVICES itself
+#   MPLC_TPU_FLEET_STATE_DIR  shared directory where each sharded
+#                             SweepService process publishes its queue
+#                             depth / admission state
+#                             (fleet.publish_shard_state) and reads the
+#                             cluster aggregate (fleet.cluster_view) —
+#                             the cross-shard queue view in /healthz and
+#                             in ServiceOverloaded redirect hints. Unset
+#                             = single-process behavior, byte-identical.
+#   MPLC_TPU_FLEET_SHARD_ID   this process's shard name in the state dir
+#                             (default pid<pid>)
+FLEET_SHARDS_ENV = "MPLC_TPU_FLEET_SHARDS"
+FLEET_STATE_DIR_ENV = "MPLC_TPU_FLEET_STATE_DIR"
+FLEET_SHARD_ID_ENV = "MPLC_TPU_FLEET_SHARD_ID"
+
 
 _barrier_degradation_warned = False
 
@@ -515,6 +538,13 @@ ENV_KNOBS = {
     # measured wall-clock (never v(S)), so a cached TPU number from a
     # different fence rate is a different measurement protocol
     "MPLC_TPU_DEVICE_FENCE_RATE": "workload",
+    # the fleet knobs reshape the fleet bench workload (shard count =
+    # process topology) and wire a service process into a shared fleet
+    # state dir (cross-shard admission view, per-shard identity) — none
+    # may leak into a cached replay or the CPU-fallback child
+    "MPLC_TPU_FLEET_SHARDS": "workload",
+    "MPLC_TPU_FLEET_STATE_DIR": "workload",
+    "MPLC_TPU_FLEET_SHARD_ID": "workload",
     # deterministic-reduce changes v(S) ITSELF (a pinned reduction order
     # is a different — bit-stable — game trajectory), and the audit
     # drains overlap + runs extra capture passes at fence ordinals, so

@@ -366,6 +366,10 @@ class CharacteristicEngine:
     # save_cache to that file rewrites it in the integrity format
     _cache_needs_upgrade = False
     _legacy_cache_path: "str | None" = None
+    # fleet width pinning (parallel/fleet.py): {(pipe, slot_count): width}
+    # from the FULL sweep's plan, so a shard evaluating only its slice
+    # still compiles the same (slot, width) programs as every other shard
+    _fleet_widths: "dict | None" = None
 
     def __init__(self, scenario, share_data_from: "CharacteristicEngine | None" = None,
                  seed_ensemble: int | None = None):
@@ -945,7 +949,18 @@ class CharacteristicEngine:
         overlap = self._pipeline_batches and pipe.dispatches_async
         n_dev = max(self._sharding.num_devices if self._sharding else 1, 1)
         cap = self._device_batch_cap(slot_count, overlap)
-        return _bucket_size(min(n_jobs, n_dev * cap), n_dev, cap)
+        width = _bucket_size(min(n_jobs, n_dev * cap), n_dev, cap)
+        if self._fleet_widths and not self._cap_halvings:
+            # fleet shard (parallel/fleet.py): run this bucket at the
+            # FULL sweep's planned width even when the slice is smaller,
+            # so every shard executes the same programs and the shared
+            # bank manifest serves W-1 of W shards. The pin never
+            # shrinks a width, and the OOM ladder un-pins: a degraded
+            # cap must re-bucket at the degraded width, not the plan's.
+            pinned = self._fleet_widths.get((pipe, slot_count))
+            if pinned:
+                return max(width, pinned)
+        return width
 
     def _bucket_plan(self, singles: list, multis: list) -> list:
         """[(pipe, slot_count, width)] in dispatch order for a 1-D
@@ -993,6 +1008,21 @@ class CharacteristicEngine:
         singles = [k for k in keys if lens[k] == 1]
         multis = [k for k in keys if lens[k] > 1]
         return self._bucket_plan(singles, multis)
+
+    def pin_fleet_widths(self, subsets) -> dict:
+        """Fleet-sweep width pinning (parallel/fleet.py): compute the
+        FULL sweep's bucket plan over `subsets` and pin this engine's
+        1-D bucket widths to it, so a shard evaluating only a slice
+        still compiles exactly the plan's (slot_count, width) programs —
+        the precondition for the shared program-bank manifest to serve
+        every shard after the first. Returns {slot_count_or_None: width}
+        for reporting. No-op (returns {}) where no 1-D plan exists (2-D
+        mode, CPU-degraded engines) — equality there never depended on
+        widths anyway."""
+        plan = self.sweep_plan(subsets)
+        self._fleet_widths = {(pipe, slot): width
+                              for pipe, slot, width in plan} or None
+        return {slot: width for _pipe, slot, width in plan}
 
     def _slot_pipe(self, k: int) -> BatchedTrainerPipeline:
         if k not in self._slot_pipes:

@@ -126,6 +126,12 @@ SPAN_REGISTRY = {
                       "flight-recorder postmortem",
     "numerics.ledger": "value-provenance ledger persisted (attrs: path/"
                        "entries/reduction_mode)",
+    "fleet.sweep": "one coordinated fleet sweep: spawn shards -> merge "
+                   "(attrs: shards/inproc/devices_per_shard)",
+    "fleet.shard": "one fleet shard completed (attrs: shard/shards/"
+                   "wallclock_s/coalitions)",
+    "fleet.merge": "per-shard ledgers/memos merged into one sweep "
+                   "(attrs: shards/coalitions/verified/wallclock_s)",
 }
 
 

@@ -1,6 +1,6 @@
 from .mesh import (CoalitionSharding, coalition_sharding, make_mesh,
-                   make_2d_mesh)
+                   make_2d_mesh, make_multihost_mesh)
 from .partner_shard import PartnerShardedTrainer
 
 __all__ = ["CoalitionSharding", "coalition_sharding", "make_mesh",
-           "make_2d_mesh", "PartnerShardedTrainer"]
+           "make_2d_mesh", "make_multihost_mesh", "PartnerShardedTrainer"]

@@ -1,0 +1,878 @@
+"""Fleet sweep plane: coalition-axis sharding across OS processes/hosts.
+
+The engine's `coal` mesh axis already shards one process's batches across
+its local devices with zero communication; this module is the next rung —
+statically partition a WHOLE sweep into W disjoint coalition slices and
+run each slice in its own OS process (on its own host, when a fleet
+exists), then merge the per-shard results into one sweep with a
+machine-checked equality proof. Three rules make the plane trustworthy:
+
+1. **Slice at bucket granularity, never mid-width.** `plan_slices` cuts
+   the sweep at the level of the engine's merged slot buckets (the same
+   classification `CharacteristicEngine.sweep_plan` uses: singles,
+   per-slot-width groups under merge/pow2/exact bucketing, all-dropped
+   null coalitions), splitting each bucket contiguously across shards.
+   Combined with `CharacteristicEngine.pin_fleet_widths` — which pins
+   every shard's batch widths to the FULL sweep's planned widths — every
+   shard compiles exactly the same (slot_count, width) programs, so a
+   shared persistent compile cache + program-bank manifest serves W-1 of
+   the W shards without a single recompile.
+
+2. **Each shard is self-verifying.** A shard runs under
+   `MPLC_TPU_DETERMINISTIC_REDUCE=1` (when the spec asks for equality
+   proofs) with its own value-provenance ledger
+   (`obs/numerics.ValueLedger`) and its own crash-safe journal (the
+   engine's checksummed autosave cache). Its LAST act is touching
+   `.shardI.done` — the same completion-marker convention
+   `scripts/merge_shards.py` established for the grid sharder, so a csv
+   present without its marker is never mistaken for a finished shard.
+
+3. **The merge is verified, not assumed.** The coordinator refuses
+   partial merges (missing markers), refuses fingerprint mismatches
+   (different GAMES), requires the shard slices to be a disjoint cover
+   of the requested sweep, and — handed a reference ledger (e.g. the
+   1-shard run's) — asserts zero-ulp, tau-b == 1.0 equality through
+   `obs/numerics.diff_ledgers`. Linearity you can trust, not assume.
+
+Cross-shard service state (`MPLC_TPU_FLEET_STATE_DIR`): a sharded
+`SweepService` deployment publishes each process's queue depth /
+admission state into the shared state dir (`publish_shard_state`), and
+`cluster_view` aggregates them — the cross-shard queue view the
+admission governor's /healthz block and overload hints read, where
+previously the governor saw only one process's queue.
+
+CLI:
+  python -m mplc_tpu.parallel.fleet --worker SPEC.json --shard I/W \
+      --out DIR [--no-ledger]
+  python -m mplc_tpu.parallel.fleet --selfcheck [--shards W]
+The selfcheck runs a tiny deterministic-reduce sweep at 1 shard and at W
+shards (real subprocesses) and exits non-zero unless `diff_ledgers`
+reports zero drift and tau-b == 1.0 — the CI fleet smoke.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+from .. import constants
+
+logger = __import__("logging").getLogger("mplc_tpu")
+
+# knob names (constants.ENV_KNOBS registers all three workload-class)
+FLEET_SHARDS_ENV = constants.FLEET_SHARDS_ENV
+FLEET_STATE_DIR_ENV = constants.FLEET_STATE_DIR_ENV
+FLEET_SHARD_ID_ENV = constants.FLEET_SHARD_ID_ENV
+
+
+class FleetError(RuntimeError):
+    """Base class for fleet-plane failures."""
+
+
+class FleetMergeError(FleetError):
+    """The per-shard results cannot be merged into one sweep: missing
+    completion markers (a shard still running or crashed), overlapping
+    or non-covering slices, or fingerprint mismatches (different
+    games)."""
+
+
+# ---------------------------------------------------------------------------
+# sweep spec: everything a worker process needs to rebuild the same game
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FleetSpec:
+    """A self-contained sweep description, JSON round-trippable so a
+    worker process reconstructs bit-identically the game the coordinator
+    described (the engine's data digest catches any divergence)."""
+    dataset: str = "titanic"
+    partners: int = 3
+    epochs: int = 2
+    dtype: str = "float32"
+    minibatch_count: int = 2
+    gradient_updates_per_pass: int = 3
+    seed: int = 0
+    # None = the full powerset sweep (contrib.shapley.powerset_order)
+    subsets: "list | None" = None
+    # equality mode: shards run under MPLC_TPU_DETERMINISTIC_REDUCE=1 so
+    # the merged ledger is bit-comparable across shard counts/topologies
+    deterministic: bool = True
+    # pin every shard's bucket widths to the full sweep's plan (identical
+    # programs across shards -> shared bank/manifest serves W-1 shards)
+    pin_widths: bool = True
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "FleetSpec":
+        doc = json.loads(text)
+        doc.pop("amounts", None)  # legacy field tolerance
+        return cls(**doc)
+
+    def all_subsets(self) -> list:
+        if self.subsets is not None:
+            return [tuple(sorted(int(i) for i in s)) for s in self.subsets]
+        from ..contrib.shapley import powerset_order
+        return list(powerset_order(self.partners))
+
+    def build_scenario(self):
+        """The bench-shaped scenario (bench._amounts proportions), built
+        identically in coordinator and every worker."""
+        from ..scenario import Scenario
+        n = self.partners
+        if n == 3:
+            amounts = [0.4, 0.3, 0.3]
+        else:
+            raw = [float(i + 1) for i in range(n)]
+            amounts = [x / sum(raw) for x in raw]
+        sc = Scenario(partners_count=n, amounts_per_partner=amounts,
+                      dataset_name=self.dataset,
+                      multi_partner_learning_approach="fedavg",
+                      aggregation_weighting="data-volume",
+                      epoch_count=self.epochs,
+                      minibatch_count=self.minibatch_count,
+                      gradient_updates_per_pass_count=(
+                          self.gradient_updates_per_pass),
+                      is_early_stopping=False, compute_dtype=self.dtype,
+                      experiment_path=tempfile.gettempdir(),
+                      is_dry_run=True, seed=self.seed)
+        sc.instantiate_scenario_partners()
+        sc.split_data(is_logging_enabled=False)
+        sc.compute_batch_sizes()
+        sc.data_corruption()
+        return sc
+
+
+# ---------------------------------------------------------------------------
+# slice planning: bucket-granular, deterministic, disjoint cover
+# ---------------------------------------------------------------------------
+
+def plan_slices(engine, subsets, n_shards: int) -> list:
+    """Partition `subsets` into `n_shards` disjoint slices, slicing at
+    the level of the engine's slot buckets (the same classification
+    `sweep_plan`/evaluate use) so no bucket is split mid-width: every
+    shard receives a contiguous chunk of EACH bucket, and — with
+    `pin_fleet_widths` — runs it at the full sweep's batch width.
+    Deterministic in (subsets order, bucketing mode, n_shards); the
+    slices' union is exactly the stable-unique subset list."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    keys = list(dict.fromkeys(
+        tuple(sorted(int(i) for i in s)) for s in subsets))
+    dropped = getattr(engine, "_forever_dropped", frozenset())
+    if dropped:
+        lens = {k: len(engine._effective_subset(k)) for k in keys}
+    else:
+        lens = {k: len(k) for k in keys}
+    nulls = [k for k in keys if lens[k] == 0]    # stored v=0, no dispatch
+    singles = [k for k in keys if lens[k] == 1]
+    multis = [k for k in keys if lens[k] > 1]
+    buckets = []
+    if nulls:
+        buckets.append(nulls)
+    if singles:
+        buckets.append(singles)
+    if multis:
+        if getattr(engine, "_use_slots", False):
+            buckets.extend(group for _w, group in engine._slot_buckets(multis))
+        else:
+            buckets.append(multis)
+    slices = [[] for _ in range(n_shards)]
+    for bucket in buckets:
+        n = len(bucket)
+        for i in range(n_shards):
+            slices[i].extend(bucket[i * n // n_shards:
+                                    (i + 1) * n // n_shards])
+    return slices
+
+
+# ---------------------------------------------------------------------------
+# per-shard execution (shared by the in-process path and the CLI worker)
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _env_overlay(overrides: dict):
+    """Temporarily set/unset environment keys (None = unset). The engine
+    reads its mode knobs at construction time, so the in-process shard
+    path needs exactly this window; the subprocess path passes a real
+    environment instead."""
+    saved = {k: os.environ.get(k) for k in overrides}
+    try:
+        for k, v in overrides.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _shard_paths(out_dir: str, shard: int) -> dict:
+    return {
+        "result": os.path.join(out_dir, f"result_shard{shard}.json"),
+        "cache": os.path.join(out_dir, f"cache_shard{shard}.json"),
+        "ledger": os.path.join(out_dir, f"ledger_shard{shard}.json"),
+        "done": os.path.join(out_dir, f".shard{shard}.done"),
+    }
+
+
+def run_shard(spec: FleetSpec, shard: int, shards: int, out_dir: str,
+              ledger: bool = True) -> dict:
+    """Execute one shard's slice to completion: build the game, pin the
+    full sweep's bucket widths, evaluate the slice under the spec's
+    reduction mode with a per-shard value ledger + crash-safe journal
+    (the engine's checksummed autosave cache), and write
+    `result_shardI.json` / `cache_shardI.json` / `ledger_shardI.json`.
+    Touches `.shardI.done` LAST — the merge refuses shards without it."""
+    if not 0 <= shard < shards:
+        raise ValueError(f"shard index {shard} outside 0..{shards - 1}")
+    os.makedirs(out_dir, exist_ok=True)
+    paths = _shard_paths(out_dir, shard)
+    # stale artifacts from a previous run into the same dir must not
+    # survive: a leftover marker could bless a half-written result
+    # (main.py's grid-shard rule), and a leftover ledger/result from an
+    # earlier run would be merged as if THIS run produced it (e.g. a
+    # ledger=False rerun silently inheriting the old ledger's values)
+    for key in ("done", "result", "ledger"):
+        with contextlib.suppress(OSError):
+            os.remove(paths[key])
+    t0 = time.perf_counter()
+    env = {"MPLC_TPU_DETERMINISTIC_REDUCE": "1" if spec.deterministic
+           else None,
+           "MPLC_TPU_NUMERICS_LEDGER": paths["ledger"] if ledger else None}
+    from ..obs import metrics as obs_metrics
+
+    def _counters():
+        snap = obs_metrics.snapshot().get("counters", {})
+        return {k: snap.get(k, 0) for k in
+                ("bank.hits", "bank.compiles", "trainer.compiles",
+                 "engine.batches")}
+
+    before = _counters()
+    from ..utils import compile_cache_entries
+    cache_dir = os.environ.get(constants.COMPILE_CACHE_DIR_ENV)
+    cache_before = (compile_cache_entries(cache_dir)
+                    if cache_dir else None)
+    with _env_overlay(env):
+        sc = spec.build_scenario()
+        from ..contrib.engine import CharacteristicEngine
+        engine = CharacteristicEngine(sc)
+    all_subsets = spec.all_subsets()
+    if spec.pin_widths:
+        engine.pin_fleet_widths(all_subsets)
+    # cross-process program reuse accounting: how many of the FULL
+    # sweep's programs the shared bank manifest already held when this
+    # shard started (every one of them deserializes from the persistent
+    # compile cache instead of recompiling — the fleet's
+    # "W-1 shards compile nothing" claim, measured per shard)
+    plan = engine.sweep_plan(all_subsets)
+    manifest_hits = 0
+    if engine.program_bank is not None and plan:
+        held = engine.program_bank.persistent_keys()
+        manifest_hits = sum(
+            1 for pipe, slot, width in plan
+            if engine.program_bank.program_key(pipe, slot, width) in held)
+    my_slice = plan_slices(engine, all_subsets, shards)[shard]
+    engine.autosave_path = paths["cache"]   # per-shard crash journal
+    # program warm-up OUTSIDE the timed sweep, mirroring bench
+    # _warm_engine's skip path: acquire every planned program now — a
+    # manifest-held program deserializes from the shared persistent
+    # cache, the prime shard compiles — so the timed sweep pays
+    # dispatch+compute only, the same timing-excludes-compilation
+    # discipline every bench config uses. The warm-up seconds are
+    # reported (warmup_s), never hidden.
+    t_warm = time.perf_counter()
+    if engine.program_bank is not None:
+        for pipe, slot, width in plan:
+            engine.program_bank.acquire(pipe, slot, width)
+    warmup_s = time.perf_counter() - t_warm
+    # the sweep proper is timed separately from shard STARTUP (scenario
+    # build, data generation, engine construction): startup happens once
+    # per resident worker and is excluded from every bench config's
+    # timed region by the warm-up discipline, so the fleet's scaling
+    # number must not smear it into the per-shard sweep time — both are
+    # reported, neither is hidden
+    t_sweep = time.perf_counter()
+    engine.evaluate(my_slice)
+    sweep_s = time.perf_counter() - t_sweep
+    if engine.numerics_ledger is not None:
+        engine.numerics_ledger.save()
+    engine.save_cache(paths["cache"])
+    after = _counters()
+    wall = time.perf_counter() - t0
+    result = {
+        "shard": shard,
+        "shards": shards,
+        "spec": dataclasses.asdict(spec),
+        # the game's engine fingerprint, so the coordinator can stamp
+        # the merged cache without rebuilding the scenario + engine
+        "fingerprint": engine._fingerprint(),
+        "subsets": [list(s) for s in my_slice],
+        "values": [[list(s), float(engine.charac_fct_values[s])]
+                   for s in my_slice],
+        "wallclock_s": wall,
+        "sweep_s": sweep_s,
+        "warmup_s": warmup_s,
+        "setup_s": wall - sweep_s - warmup_s,
+        "devices": _local_device_count(),
+        "deterministic": bool(spec.deterministic),
+        "counters": {k: after[k] - before[k] for k in after},
+        "programs_planned": len(plan),
+        "manifest_hits": manifest_hits,
+        "compile_cache_new_entries": (
+            (compile_cache_entries(cache_dir) or 0) - (cache_before or 0)
+            if cache_dir and cache_before is not None else None),
+        "widths": sorted({w for (_p, _s), w in
+                          (engine._fleet_widths or {}).items()})
+        if engine._fleet_widths else [],
+    }
+    _atomic_json(paths["result"], result)
+    # LAST act: the completion marker (crash before this line = no merge)
+    with open(paths["done"], "w") as f:
+        f.write(str(int(time.time())))
+    return result
+
+
+def _local_device_count() -> int:
+    try:
+        import jax
+        return len(jax.devices())
+    except Exception:
+        return 0
+
+
+def _atomic_json(path: str, doc: dict) -> None:
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# merging: disjoint-cover check, ledger union, rebuilt increments
+# ---------------------------------------------------------------------------
+
+def merge_ledgers(docs: list) -> dict:
+    """Union W shard ledgers (to_doc() dicts) into one merged ledger doc.
+    Refuses fingerprint mismatches (different games) and overlapping
+    subset keys (a slice bug — two shards trained the same coalition)."""
+    if not docs:
+        raise FleetMergeError("no shard ledgers to merge")
+    fps = {d.get("engine_fingerprint") for d in docs}
+    if len(fps) != 1:
+        raise FleetMergeError(
+            f"shard ledgers carry {len(fps)} distinct engine fingerprints "
+            f"({sorted(str(f)[:16] for f in fps)}) — these are different "
+            "games and must not be merged")
+    entries: dict = {}
+    for i, d in enumerate(docs):
+        for k, e in (d.get("entries") or {}).items():
+            if k in entries:
+                raise FleetMergeError(
+                    f"subset {k} appears in more than one shard ledger "
+                    f"(shard {i} overlaps an earlier slice)")
+            entries[k] = e
+    meta = dict(docs[0].get("meta") or {})
+    meta.update(fleet_shards=len(docs), merged=True)
+    return {"schema": docs[0].get("schema", 1),
+            "engine_fingerprint": docs[0].get("engine_fingerprint"),
+            "meta": meta, "entries": entries}
+
+
+def _rebuild_increments(values: dict, partners_count: int) -> list:
+    """The engine's marginal-increment bookkeeping, recomputed over the
+    MERGED memo (per-shard increment dicts are incomplete: a pair split
+    across shards contributes to neither side's bookkeeping)."""
+    inc = [dict() for _ in range(partners_count)]
+    for subset, v in values.items():
+        sset = set(subset)
+        for i in range(partners_count):
+            if i in sset:
+                without = tuple(sorted(sset - {i}))
+                if without in values:
+                    inc[i][without] = v - values[without]
+    return inc
+
+
+def write_cache_doc(path: str, fingerprint: dict, values: dict,
+                    partners_count: int) -> None:
+    """Persist a merged memo in the engine's checksummed cache format
+    (`CharacteristicEngine.load_cache` accepts it), increments rebuilt
+    over the merged value set."""
+    import hashlib
+    payload = {
+        "fingerprint": fingerprint,
+        "first_charac_fct_calls_count": len(values),
+        "charac_fct_values": [[list(k), v] for k, v in values.items()],
+        "increments_values": [
+            [[list(k), v] for k, v in d.items()]
+            for d in _rebuild_increments(values, partners_count)],
+    }
+    body = json.dumps(payload)
+    digest = hashlib.sha256(body.encode()).hexdigest()
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write('{"payload_sha256": "%s", %s' % (digest, body[1:]))
+    os.replace(tmp, path)
+
+
+@dataclasses.dataclass
+class FleetResult:
+    values: dict                 # {subset tuple: v(S)} over the whole sweep
+    ledger: "dict | None"        # merged ledger doc (None = ledger off)
+    shard_reports: list          # per-shard result_shardI.json docs
+    wallclock_s: float           # coordinator wall: spawn -> merge done
+    per_shard_wall_s: list       # each shard's own total wall-clock
+    out_dir: str
+    diff: "dict | None" = None   # diff_ledgers vs the reference, if given
+    # each shard's sweep-only wall-clock (startup — scenario/data/engine
+    # build, once per resident worker — reported separately in the shard
+    # reports as setup_s); max() over this is the fleet's critical path
+    # under the bench's timing-excludes-warm-up discipline
+    per_shard_sweep_s: "list | None" = None
+
+
+def merge_shard_results(spec: FleetSpec, shards: int, out_dir: str,
+                        force: bool = False) -> tuple:
+    """Read + validate the W shards' outputs. Returns
+    (values, merged_ledger_doc_or_None, shard_reports). Refuses missing
+    `.shardI.done` markers (unless `force`), non-covering or overlapping
+    slices, and mismatched ledger fingerprints."""
+    missing = [i for i in range(shards)
+               if not os.path.exists(_shard_paths(out_dir, i)["done"])]
+    if missing and not force:
+        raise FleetMergeError(
+            f"{out_dir} has no done markers for shards {missing} — those "
+            "workers are still running or crashed (result presence is not "
+            "completion); force=True to merge anyway")
+    reports = []
+    values: dict = {}
+    ledger_docs = []
+    for i in range(shards):
+        paths = _shard_paths(out_dir, i)
+        if not os.path.exists(paths["result"]):
+            if force:
+                continue
+            raise FleetMergeError(f"shard {i} left no result file "
+                                  f"({paths['result']})")
+        with open(paths["result"]) as f:
+            rep = json.load(f)
+        reports.append(rep)
+        for s, v in rep["values"]:
+            key = tuple(int(x) for x in s)
+            if key in values:
+                raise FleetMergeError(
+                    f"subset {key} evaluated by more than one shard "
+                    f"(shard {i} overlaps an earlier slice)")
+            values[key] = float(v)
+        if os.path.exists(paths["ledger"]):
+            with open(paths["ledger"]) as f:
+                ledger_docs.append(json.load(f))
+    expected = set(spec.all_subsets())
+    if not force and set(values) != expected:
+        short = sorted(expected - set(values))[:8]
+        raise FleetMergeError(
+            f"merged shard values do not cover the sweep: "
+            f"{len(values)}/{len(expected)} subsets (first missing: "
+            f"{short})")
+    merged = merge_ledgers(ledger_docs) if ledger_docs else None
+    return values, merged, reports
+
+
+def run_fleet(spec: FleetSpec, shards: int, out_dir: str,
+              inproc: bool = False, devices_per_shard: "int | None" = None,
+              env: "dict | None" = None,
+              per_shard_env: "dict | None" = None,
+              ledger: bool = True, timeout: float = 3600.0,
+              concurrent: bool = True,
+              verify_against: "dict | str | None" = None) -> FleetResult:
+    """Run a W-shard fleet sweep and merge it.
+
+    `inproc=True` executes the shards sequentially in this process
+    (tests; the slice/merge/equality machinery is identical, only the
+    process boundary is skipped). Otherwise each shard is a subprocess
+    of this interpreter running `-m mplc_tpu.parallel.fleet --worker`,
+    launched concurrently (`concurrent=False` runs them one at a time —
+    the honest mode on a host with fewer cores than shards, where
+    concurrent workers would only time-slice; per-shard wall-clocks
+    then measure each shard's real work and `per_shard_wall_s`'s max is
+    the fleet's critical path); `devices_per_shard` forces the CPU-mesh
+    size per worker (`--xla_force_host_platform_device_count`), and
+    `per_shard_env` ({shard_index: {KEY: value}}) injects per-shard
+    knobs (e.g. a fault plan on one shard). `verify_against` (a ledger
+    doc or path) asserts the merged ledger diffs CLEAN — zero ulp drift,
+    tau-b 1.0 — against the reference and raises FleetMergeError
+    otherwise."""
+    from ..obs import trace as obs_trace
+    os.makedirs(out_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    with obs_trace.span("fleet.sweep", shards=shards,
+                        inproc=bool(inproc),
+                        devices_per_shard=devices_per_shard):
+        if inproc:
+            for i in range(shards):
+                with _env_overlay((per_shard_env or {}).get(i) or {}):
+                    rep = run_shard(spec, i, shards, out_dir,
+                                    ledger=ledger)
+                obs_trace.event("fleet.shard", shard=i, shards=shards,
+                                wallclock_s=rep["wallclock_s"],
+                                coalitions=len(rep["subsets"]))
+        else:
+            _run_fleet_subprocess(spec, shards, out_dir,
+                                  devices_per_shard, env, per_shard_env,
+                                  ledger, timeout, concurrent)
+        values, merged, reports = merge_shard_results(spec, shards, out_dir)
+        if merged is not None:
+            _atomic_json(os.path.join(out_dir, "ledger_merged.json"),
+                         merged)
+        if reports:
+            # the shard workers already computed the fingerprint —
+            # stamping the merged cache must not rebuild the whole
+            # scenario + engine in the coordinator
+            fp = reports[0].get("fingerprint")
+            if fp is None:
+                with _env_overlay(
+                        {"MPLC_TPU_DETERMINISTIC_REDUCE":
+                         "1" if spec.deterministic else None}):
+                    fp = _spec_fingerprint(spec)
+            if fp is not None:
+                write_cache_doc(os.path.join(out_dir, "cache_merged.json"),
+                                fp, values, spec.partners)
+        diff = None
+        if verify_against is not None:
+            if merged is None:
+                # the caller asked for an equality proof; a run with no
+                # ledgers has no bits to compare — that is a refusal,
+                # never a silent pass
+                raise FleetMergeError(
+                    "verify_against given but the fleet run produced no "
+                    "merged ledger (ledger=False, or no shard wrote "
+                    "one) — nothing was verified")
+            if isinstance(verify_against, str):
+                with open(verify_against) as f:
+                    verify_against = json.load(f)
+            from ..obs.numerics import diff_ledgers
+            diff = diff_ledgers(verify_against, merged)
+            diff.pop("per_subset", None)
+            expected_n = len(spec.all_subsets())
+            if (diff["drift"] or not diff["comparable"]
+                    or diff["common"] != expected_n):
+                raise FleetMergeError(
+                    f"fleet merge FAILED verification vs the reference "
+                    f"ledger: comparable={diff['comparable']} "
+                    f"drift={diff['drift']} "
+                    f"covered={diff['common']}/{expected_n} subsets "
+                    f"ulp={diff['ulp']} tau={diff['kendall_tau']}")
+        wall = time.perf_counter() - t0
+        obs_trace.event("fleet.merge", shards=shards,
+                        coalitions=len(values),
+                        verified=verify_against is not None,
+                        wallclock_s=wall)
+    return FleetResult(values=values, ledger=merged,
+                       shard_reports=reports, wallclock_s=wall,
+                       per_shard_wall_s=[r["wallclock_s"] for r in reports],
+                       out_dir=out_dir, diff=diff,
+                       per_shard_sweep_s=[r.get("sweep_s",
+                                                r["wallclock_s"])
+                                          for r in reports])
+
+
+def _spec_fingerprint(spec: FleetSpec) -> "dict | None":
+    """The engine fingerprint of the spec's game, for the merged cache
+    doc. Rebuilds the scenario+engine (cheap for the tiny fleet games;
+    the coordinator usually ran a shard in-process anyway and the
+    trainer registry caches the compiles). None on any failure — the
+    merged cache is a convenience artifact, never worth failing a merge
+    that already verified."""
+    try:
+        sc = spec.build_scenario()
+        from ..contrib.engine import CharacteristicEngine
+        return CharacteristicEngine(sc)._fingerprint()
+    except Exception as e:  # noqa: BLE001 — convenience artifact only
+        logger.warning("fleet: merged-cache fingerprint unavailable (%s)", e)
+        return None
+
+
+def worker_env(base: "dict | None" = None,
+               devices: "int | None" = None,
+               extra: "dict | None" = None) -> dict:
+    """A worker subprocess environment: the caller's env with the CPU
+    mesh size forced (when `devices` is given) and per-shard overrides
+    applied. The force flag REPLACES any inherited one — a worker must
+    never silently inherit the parent's 8-device test mesh."""
+    env = dict(os.environ if base is None else base)
+    if devices is not None:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_"
+                            f"count={devices}").strip()
+        env["JAX_PLATFORMS"] = "cpu"
+    for k, v in (extra or {}).items():
+        if v is None:
+            env.pop(k, None)
+        else:
+            env[k] = str(v)
+    return env
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def worker_argv(spec_path: str, shard: int, shards: int, out_dir: str,
+                ledger: bool = True) -> list:
+    """The worker CLI invocation — THE one place the subprocess protocol
+    (module path + flag shape) lives; the coordinator and the bench's
+    compile-prime both build their commands here."""
+    return ([sys.executable, "-m", "mplc_tpu.parallel.fleet",
+             "--worker", spec_path, "--shard", f"{shard}/{shards}",
+             "--out", out_dir] + ([] if ledger else ["--no-ledger"]))
+
+
+def run_worker_subprocess(spec: FleetSpec, shard: int, shards: int,
+                          out_dir: str,
+                          devices: "int | None" = None,
+                          env: "dict | None" = None,
+                          ledger: bool = True,
+                          timeout: float = 3600.0) -> None:
+    """Run ONE shard worker as a subprocess and wait for it (the bench's
+    compile-prime; the coordinator's multi-worker launch shares the same
+    argv/env builders). Raises FleetError on a non-zero exit, with the
+    worker log tail."""
+    os.makedirs(out_dir, exist_ok=True)
+    spec_path = os.path.join(out_dir, "fleet_spec.json")
+    with open(spec_path, "w") as f:
+        f.write(spec.to_json())
+    wenv = worker_env(env, devices)
+    wenv.setdefault("PYTHONPATH", _repo_root())
+    log_path = os.path.join(out_dir, f"worker_shard{shard}.log")
+    with open(log_path, "w") as log:
+        try:
+            rc = subprocess.run(
+                worker_argv(spec_path, shard, shards, out_dir, ledger),
+                env=wenv, stdout=log, stderr=subprocess.STDOUT,
+                cwd=_repo_root(), timeout=timeout).returncode
+        except subprocess.TimeoutExpired:
+            rc = -9
+    if rc != 0:
+        tail = ""
+        with contextlib.suppress(OSError):
+            with open(log_path) as f:
+                tail = f.read()[-2000:]
+        raise FleetError(
+            f"fleet worker shard {shard}/{shards} failed rc={rc}: "
+            f"...{tail[-400:]}")
+
+
+def _run_fleet_subprocess(spec, shards, out_dir, devices_per_shard, env,
+                          per_shard_env, ledger, timeout,
+                          concurrent=True) -> None:
+    spec_path = os.path.join(out_dir, "fleet_spec.json")
+    with open(spec_path, "w") as f:
+        f.write(spec.to_json())
+    repo_root = _repo_root()
+    deadline = time.monotonic() + timeout
+
+    def _spawn(i):
+        wenv = worker_env(env, devices_per_shard,
+                          (per_shard_env or {}).get(i))
+        wenv.setdefault("PYTHONPATH", repo_root)
+        log_path = os.path.join(out_dir, f"worker_shard{i}.log")
+        log = open(log_path, "w")
+        return (i, subprocess.Popen(
+            worker_argv(spec_path, i, shards, out_dir, ledger),
+            env=wenv, stdout=log, stderr=subprocess.STDOUT,
+            cwd=repo_root), log, log_path)
+
+    def _wait(i, p, log, log_path):
+        left = max(1.0, deadline - time.monotonic())
+        try:
+            rc = p.wait(left)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            rc = -9
+        log.close()
+        if rc == 0:
+            return None
+        tail = ""
+        with contextlib.suppress(OSError):
+            with open(log_path) as f:
+                tail = f.read()[-2000:]
+        return (i, rc, tail)
+
+    failed = []
+    if concurrent:
+        procs = [_spawn(i) for i in range(shards)]
+        failed = [f for f in (_wait(*p) for p in procs) if f is not None]
+    else:
+        for i in range(shards):
+            f = _wait(*_spawn(i))
+            if f is not None:
+                failed.append(f)
+    if failed:
+        detail = "; ".join(f"shard {i} rc={rc}: ...{tail[-400:]}"
+                           for i, rc, tail in failed)
+        raise FleetError(f"{len(failed)} fleet worker(s) failed: {detail}")
+
+
+# ---------------------------------------------------------------------------
+# cross-shard service state (the admission governor's fleet view)
+# ---------------------------------------------------------------------------
+
+def publish_shard_state(state_dir: str, shard_id: str,
+                        payload: dict) -> None:
+    """Atomically publish one service shard's queue/admission snapshot
+    into the shared fleet state dir. Never raises — a full disk must not
+    take down the service whose state it merely mirrors."""
+    try:
+        os.makedirs(state_dir, exist_ok=True)
+        safe = re.sub(r"[^A-Za-z0-9_.-]", "_", str(shard_id))
+        _atomic_json(os.path.join(state_dir, f"shard_{safe}.json"),
+                     {**payload, "shard": str(shard_id),
+                      "ts": time.time()})
+    except OSError as e:
+        logger.warning("fleet: shard-state publish to %r failed: %s",
+                       state_dir, e)
+
+
+def cluster_view(state_dir: str, stale_sec: float = 30.0) -> dict:
+    """Aggregate every shard's published state: per-shard rows (stale
+    ones flagged, not dropped — a wedged shard's last word is evidence)
+    plus cluster totals the admission governor and /healthz expose.
+    `least_loaded` names the live shard with the shallowest queue — the
+    redirect hint an overloaded shard hands back to fleet routers. A
+    shard that published `closed: true` (shutting down — it may still
+    be draining, but accepts nothing) is excluded from the live set, so
+    a router is never redirected at a closing service."""
+    shards = {}
+    now = time.time()
+    try:
+        names = sorted(os.listdir(state_dir))
+    except OSError:
+        names = []
+    for name in names:
+        if not (name.startswith("shard_") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(state_dir, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        age = now - float(doc.get("ts") or 0)
+        doc["age_sec"] = age
+        doc["stale"] = age > stale_sec
+        shards[str(doc.get("shard") or name)] = doc
+    live = {k: d for k, d in shards.items()
+            if not d["stale"] and not d.get("closed")}
+    depth = sum(int(d.get("queue_depth") or 0) for d in live.values())
+    pending = sum(int(d.get("jobs_pending") or 0) for d in live.values())
+    least = min(live, key=lambda k: int(live[k].get("queue_depth") or 0),
+                default=None)
+    return {"shards": shards, "live_shards": len(live),
+            "stale_shards": sum(1 for d in shards.values() if d["stale"]),
+            "cluster_queue_depth": depth,
+            "cluster_jobs_pending": pending,
+            "least_loaded": least}
+
+
+# ---------------------------------------------------------------------------
+# CLI: worker + selfcheck
+# ---------------------------------------------------------------------------
+
+def _cli_worker(args) -> int:
+    m = re.fullmatch(r"(\d+)/(\d+)", args.shard)
+    if not m:
+        print(f"--shard must be I/W, got {args.shard!r}", file=sys.stderr)
+        return 2
+    shard, shards = int(m.group(1)), int(m.group(2))
+    # mirror tests/conftest.py: an ambient sitecustomize can pin the jax
+    # platform config at startup, so an env-var override alone is
+    # ignored — force the config to the env's choice before backend init
+    platform = os.environ.get("JAX_PLATFORMS")
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform.split(",")[0])
+    with open(args.spec) as f:
+        spec = FleetSpec.from_json(f.read())
+    rep = run_shard(spec, shard, shards, args.out,
+                    ledger=not args.no_ledger)
+    print(json.dumps({"shard": shard, "coalitions": len(rep["subsets"]),
+                      "wallclock_s": rep["wallclock_s"]}))
+    return 0
+
+
+def _cli_selfcheck(args) -> int:
+    """The CI fleet smoke: a tiny deterministic titanic sweep at 1 shard
+    then at `--shards` shards (real worker subprocesses), merged ledgers
+    diffed — exit 0 only on zero ulp drift and tau-b == 1.0."""
+    from ..obs.numerics import diff_ledgers
+    spec = FleetSpec()  # titanic, 3 partners, 2 epochs, deterministic
+    with tempfile.TemporaryDirectory(prefix="mplc_fleet_smoke_") as tmp:
+        env = worker_env(devices=1,
+                         extra={"MPLC_TPU_SYNTH_SCALE":
+                                os.environ.get("MPLC_TPU_SYNTH_SCALE",
+                                               "0.02"),
+                                "BENCH_TELEMETRY_FILE": None})
+        t0 = time.perf_counter()
+        ref = run_fleet(spec, 1, os.path.join(tmp, "w1"), env=env,
+                        devices_per_shard=1, timeout=args.timeout)
+        got = run_fleet(spec, args.shards, os.path.join(tmp, "w"), env=env,
+                        devices_per_shard=1, timeout=args.timeout)
+        diff = diff_ledgers(ref.ledger, got.ledger)
+        ok = (diff["comparable"] and not diff["drift"]
+              and diff["kendall_tau"] == 1.0
+              and diff["common"] == len(spec.all_subsets()))
+        print(json.dumps({
+            "shards": args.shards, "subsets": diff["common"],
+            "comparable": diff["comparable"], "drift": diff["drift"],
+            "max_ulp": diff["ulp"]["max"],
+            "kendall_tau": diff["kendall_tau"],
+            "wallclock_s": round(time.perf_counter() - t0, 1),
+            "ok": ok}))
+        if not ok:
+            print(f"[fleet] selfcheck FAILED: {args.shards}-shard merged "
+                  "ledger is not bit-identical to the 1-shard run",
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--worker", dest="spec", default=None,
+                    help="run as a shard worker over this FleetSpec JSON")
+    ap.add_argument("--shard", default=None, help="I/W (worker mode)")
+    ap.add_argument("--out", default=None, help="shared output dir")
+    ap.add_argument("--no-ledger", action="store_true")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="run the 1-vs-W-shard equality smoke and exit "
+                         "non-zero on any drift")
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=1200.0)
+    args = ap.parse_args(argv)
+    if args.spec:
+        if not (args.shard and args.out):
+            ap.error("--worker requires --shard I/W and --out DIR")
+        return _cli_worker(args)
+    if args.selfcheck:
+        return _cli_selfcheck(args)
+    ap.error("one of --worker/--selfcheck is required")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

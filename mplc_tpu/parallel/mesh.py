@@ -55,8 +55,50 @@ def coalition_sharding(devices=None) -> CoalitionSharding | None:
 
 
 def make_2d_mesh(coal: int, part: int, devices=None) -> Mesh:
-    """[coal, part] mesh: coalition batch x partner sharding."""
-    devices = jax.devices() if devices is None else devices
-    assert coal * part == len(devices), (
-        f"mesh {coal}x{part} needs {coal * part} devices, have {len(devices)}")
+    """[coal, part] mesh: coalition batch x partner sharding.
+
+    Raises ValueError (not assert — asserts vanish under `python -O`,
+    and a silently mis-shaped mesh would train the wrong partition) when
+    the requested grid does not tile the device list exactly."""
+    devices = jax.devices() if devices is None else list(devices)
+    if coal * part != len(devices):
+        raise ValueError(
+            f"mesh {coal}x{part} needs {coal * part} devices, have "
+            f"{len(devices)}")
     return Mesh(np.asarray(devices).reshape(coal, part), ("coal", "part"))
+
+
+def make_multihost_mesh(part: int = 1, devices=None) -> Mesh:
+    """N-host x local [coal, part] mesh for the fleet plane: the `coal`
+    axis SPANS hosts (coalition batches are zero-communication, so the
+    axis rides across the slow inter-host fabric for free) while `part`
+    stays INTRA-host — the per-round partner `psum`/all-gather never
+    leaves a host's ICI domain. On an N x 8 fleet with part=2 this is a
+    [4N, 2] mesh: 4N-way coalition parallelism, 2-way partner sharding
+    inside each host.
+
+    Devices are grouped by `process_index` (a host in the multi-process
+    runtime; one group on a single-process CPU/test mesh) and ordered by
+    id within a host, so the mesh layout is deterministic across
+    processes. ValueErrors: uneven per-host device counts, or `part` not
+    dividing the per-host count."""
+    devices = jax.devices() if devices is None else list(devices)
+    by_host: dict = {}
+    for d in devices:
+        by_host.setdefault(getattr(d, "process_index", 0), []).append(d)
+    counts = {h: len(ds) for h, ds in by_host.items()}
+    if len(set(counts.values())) != 1:
+        raise ValueError(
+            f"multi-host mesh needs the same device count on every host, "
+            f"got {counts}")
+    local = next(iter(counts.values()))
+    if part < 1 or local % part:
+        raise ValueError(
+            f"part={part} must be >= 1 and divide the per-host device "
+            f"count ({local}); hosts={sorted(by_host)}")
+    rows = []
+    for h in sorted(by_host):
+        host_devs = sorted(by_host[h], key=lambda d: d.id)
+        rows.append(np.asarray(host_devs, dtype=object).reshape(
+            local // part, part))
+    return Mesh(np.concatenate(rows, axis=0), ("coal", "part"))

@@ -178,7 +178,13 @@ class ServiceOverloaded(ServiceError):
     queue-wait p50 (roughly one queue's worth of patience), or 0.0 when
     no job has ever been scheduled. Callers should sleep about that long
     before resubmitting instead of hammering `submit` in a tight loop —
-    the load harness (scripts/load_gen.py) does exactly that."""
+    the load harness (scripts/load_gen.py) does exactly that.
+
+    `cluster` (sharded fleet deployments, MPLC_TPU_FLEET_STATE_DIR) is
+    the cross-shard queue view at rejection time — its `least_loaded`
+    shard is the redirect hint; None outside a fleet."""
+
+    cluster: "dict | None" = None
 
     def __init__(self, msg: str, retry_after_sec: float = 0.0):
         super().__init__(msg)
@@ -488,6 +494,18 @@ class SweepService:
         self._live_deadline = constants._env_nonneg_float(
             constants.LIVE_QUERY_DEADLINE_ENV, 0.0)
         self._heartbeat = time.monotonic()
+        # fleet scale-out (parallel/fleet.py): when MPLC_TPU_FLEET_STATE_DIR
+        # names a shared state dir, this process publishes its queue
+        # depth / admission state there (rate-limited, at submits and
+        # heartbeats) and reads the CLUSTER aggregate back into /healthz
+        # and into ServiceOverloaded redirect hints — the cross-shard
+        # queue view the single-process admission governor lacked. Unset
+        # = single-process behavior, byte-identical.
+        self._fleet_dir = os.environ.get(constants.FLEET_STATE_DIR_ENV) \
+            or None
+        self._fleet_shard = (os.environ.get(constants.FLEET_SHARD_ID_ENV)
+                             or f"pid{os.getpid()}")
+        self._fleet_pub_ts = 0.0
         # live telemetry plane: the /metrics//healthz//varz endpoints
         # exist ONLY when MPLC_TPU_METRICS_PORT is set (no thread, no
         # socket otherwise); health/varz providers register either way,
@@ -659,7 +677,10 @@ class SweepService:
         stalled = bool(stalled_busy)
         all_wedged = bool(busy) and len(stalled_busy) == len(busy)
         running_names = [s["running_job"] for s in busy]
+        fleet_view = self._fleet_view()
+        extra = {} if fleet_view is None else {"fleet": fleet_view}
         return {
+            **extra,
             "healthy": worker_alive and not all_wedged,
             "worker_alive": worker_alive,
             "workers": slots,
@@ -796,6 +817,23 @@ class SweepService:
         elif int(priority) < 0:
             raise ValueError(
                 f"priority must be a non-negative tier, got {priority!r}")
+        # cross-shard redirect data is read OUTSIDE the lock (the fleet
+        # state dir is typically a shared/network filesystem — per-file
+        # reads under the service-wide lock would stall every worker
+        # heartbeat exactly when the service is saturated), gated on an
+        # unlocked approximate fullness pre-check so the happy path
+        # never touches the dir. A race (queue drains between the
+        # pre-check and the locked check) only costs the hint, never
+        # correctness.
+        fleet_view = None
+        if self._fleet_dir is not None:
+            try:
+                approx_pending = sum(1 for j in list(self._jobs.values())
+                                     if not j.done)
+            except RuntimeError:   # dict mutated mid-iteration
+                approx_pending = self._max_pending
+            if approx_pending >= self._max_pending:
+                fleet_view = self._fleet_view()
         with self._lock:
             if self._closed:
                 raise ServiceClosed("service is shut down")
@@ -822,12 +860,26 @@ class SweepService:
                 obs_trace.event("service.reject", tenant=tenant,
                                 ordinal=ordinal, reason="backpressure")
                 hint = self._admission.retry_after_sec()
-                raise ServiceOverloaded(
+                # cross-shard redirect hint: in a sharded fleet
+                # deployment a full local queue is not a full CLUSTER —
+                # name the least-loaded live sibling so a router can
+                # resubmit there instead of backing off (view read
+                # before the lock; None when the pre-check raced)
+                redirect = ""
+                if fleet_view is not None:
+                    least = fleet_view.get("least_loaded")
+                    if least and least != self._fleet_shard:
+                        redirect = (f"; fleet shard {least!r} has the "
+                                    "shallowest queue (cluster depth "
+                                    f"{fleet_view['cluster_queue_depth']})")
+                err = ServiceOverloaded(
                     f"submission queue is full ({pending} pending >= "
                     f"{constants.SERVICE_MAX_PENDING_ENV}="
                     f"{self._max_pending}); resubmit after jobs drain "
-                    f"(retry_after_sec={hint:.3f})",
+                    f"(retry_after_sec={hint:.3f}){redirect}",
                     retry_after_sec=hint)
+                err.cluster = fleet_view
+                raise err
             if job_id is None:
                 job_id = f"job{ordinal}"
             if job_id in self._jobs:
@@ -867,6 +919,9 @@ class SweepService:
                             priority=int(priority))
             self._queue.push(job)
             self._lock.notify_all()
+        # the accepted submission moved the queue depth: let the fleet's
+        # sibling shards (and their overload hints) see it promptly
+        self._publish_fleet_state(force=True)
         return job
 
     # -- the live contributivity tier ------------------------------------
@@ -1020,14 +1075,25 @@ class SweepService:
             with self._lock:
                 victims, job = self._pick_locked()
                 while job is None and not victims and not self._closed:
-                    self._lock.wait()
+                    # in a fleet deployment the idle wait is BOUNDED so an
+                    # idle shard keeps publishing its (empty) queue state —
+                    # an idle sibling that goes stale is excluded from
+                    # least_loaded exactly when it is the best redirect
+                    # target. Non-fleet services keep the untimed wait.
+                    timed_out = not self._lock.wait(
+                        timeout=10.0 if self._fleet_dir else None)
                     victims, job = self._pick_locked()
+                    if timed_out and job is None and not victims:
+                        break
                 if job is not None:
                     worker.running_job = job
             self._shed_all(victims)
             if job is None:
                 if self._closed:
                     return  # closed and drained
+                # idle heartbeat: publish outside the lock (no-op
+                # without MPLC_TPU_FLEET_STATE_DIR), then re-check
+                self._publish_fleet_state()
                 continue  # everything poppable was shed; re-check
             alive = False
             try:
@@ -1132,6 +1198,44 @@ class SweepService:
             worker.heartbeat = now
         else:
             self._heartbeat = now
+        self._publish_fleet_state()
+
+    def _publish_fleet_state(self, force: bool = False) -> None:
+        """Mirror this shard's queue/admission state into the shared
+        fleet state dir (no-op without MPLC_TPU_FLEET_STATE_DIR).
+        Rate-limited so the per-batch heartbeat path never turns into a
+        per-batch fsync; the snapshot is taken under the lock, the file
+        write happens outside it. Never raises."""
+        if not self._fleet_dir:
+            return
+        now = time.monotonic()
+        if not force and now - self._fleet_pub_ts < 0.5:
+            return
+        self._fleet_pub_ts = now
+        with self._lock:
+            payload = {
+                "queue_depth": len(self._queue),
+                "jobs_pending": sum(1 for j in self._jobs.values()
+                                    if not j.done),
+                "max_pending": self._max_pending,
+                "workers": max(len(self._workers), 1),
+                "admission_state": self._admission.state,
+                "closed": self._closed,
+            }
+        from ..parallel import fleet
+        fleet.publish_shard_state(self._fleet_dir, self._fleet_shard,
+                                  payload)
+
+    def _fleet_view(self) -> "dict | None":
+        """The cross-shard cluster aggregate (None without a state dir):
+        per-shard queue depths, cluster totals, and the least-loaded
+        live shard — what /healthz exposes and overload hints cite."""
+        if not self._fleet_dir:
+            return None
+        from ..parallel import fleet
+        view = fleet.cluster_view(self._fleet_dir)
+        view["shard_id"] = self._fleet_shard
+        return view
 
     @staticmethod
     def _device_ctx(worker: "_WorkerSlot | None"):

@@ -306,6 +306,14 @@ def main(argv=None) -> int:
     ap.add_argument("new", help="candidate sidecar .json (or directory)")
     ap.add_argument("--threshold", type=float, default=0.10,
                     help="fractional regression gate (default 0.10)")
+    ap.add_argument("--gate", action="store_true",
+                    help="strict CI mode: exit 2 unless the diff actually "
+                         "compared rows between provenance-comparable "
+                         "sidecars AND the numerics value-truth gate ran "
+                         "(both sides carried a same-fingerprint numerics "
+                         "block) — a gate that compared nothing, or that "
+                         "silently skipped the value bits, must not read "
+                         "green")
     args = ap.parse_args(argv)
 
     try:
@@ -323,6 +331,8 @@ def main(argv=None) -> int:
             jobs = [("", args.old, args.new)]
         regressed = False
         compared_total = 0
+        numerics_rows = 0
+        incomparable = 0
         for label, p_old, p_new in jobs:
             result = diff_sidecars(_load(p_old), _load(p_new),
                                    args.threshold)
@@ -330,6 +340,23 @@ def main(argv=None) -> int:
                               args.threshold))
             regressed = regressed or bool(result["regressions"])
             compared_total += result.get("compared_rows", 0)
+            numerics_rows += sum(1 for r in result["rows"]
+                                 if r["row"].startswith("numerics."))
+            incomparable += 0 if result["comparable"] else 1
+        if args.gate:
+            problems = []
+            if not compared_total:
+                problems.append("zero rows compared")
+            if incomparable:
+                problems.append(f"{incomparable} pair(s) provenance-"
+                                "incomparable (deltas not gated)")
+            if not numerics_rows:
+                problems.append("the numerics value-truth gate never ran "
+                                "(no same-fingerprint numerics blocks)")
+            if problems:
+                print("[bench_diff] --gate error: "
+                      + "; ".join(problems), file=sys.stderr)
+                return 2
         if dir_mode and not compared_total:
             # name-matched pairs existed but every one of them diffed
             # ZERO rows (schema-disjoint sidecars — e.g. a run dir whose

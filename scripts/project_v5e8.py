@@ -202,6 +202,56 @@ def load_telemetry_live(path):
     return _telemetry_row(path, "live")
 
 
+def load_measured_fleet(path):
+    """The measured fleet-scaling sidecar (BENCH_CONFIG=9,
+    perf/telemetry_config9.json): {} when the sidecar is absent, invalid
+    or carries no fleet points. The PRECEDENCE RULE lives on this
+    accessor: when a measured curve exists, main() prints it and marks
+    the pinned 280-300 s projection SUPERSEDED (pins kept above for
+    comparison); when it doesn't, the projection stands and says so."""
+    import json
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    fl = rec.get("fleet") or {}
+    if not fl.get("points"):
+        return {}
+    return {"metric": rec.get("metric"),
+            "wallclock_s": rec.get("wallclock_s"),
+            "devices": rec.get("devices"), **fl}
+
+
+def format_measured_fleet(measured, path):
+    """The measured-vs-projected printout (shared with the test pin)."""
+    lines = [f"MEASURED fleet scaling (BENCH_CONFIG=9 sidecar {path}, "
+             f"provenance={measured.get('provenance')}, "
+             f"basis={measured.get('scaling_basis')}):"]
+    for p in measured["points"]:
+        sp = p.get("speedup_vs_1")
+        lines.append(
+            f"  devices={p['devices']:2d} shards={p['shards']} "
+            f"wall={p['fleet_wallclock_s']:.1f}s speedup_vs_1="
+            + (f"{sp:.2f}x" if sp else "n/a"))
+    eq = measured.get("equality") or {}
+    if eq:
+        lines.append(
+            f"  equality: {eq.get('shards')}-shard merged ledger vs "
+            f"1-shard drift={eq.get('drift')} "
+            f"max_ulp={(eq.get('ulp') or {}).get('max')} "
+            f"tau={eq.get('kendall_tau')}")
+    note = ""
+    if measured.get("provenance") == "cpu_mesh":
+        note = ("; cpu_mesh provenance — a host-CPU mesh measurement, "
+                "not a TPU number")
+    lines.append(
+        "  >>> the pinned 280-300 s v5e-8 PROJECTION above is SUPERSEDED "
+        "by this measured wall-clock-vs-shards curve (projection pins "
+        f"kept above for comparison{note})")
+    return "\n".join(lines)
+
+
 def parse_batch_times(log_path):
     """Per-slot-size batch durations (s), from either input kind:
 
@@ -370,6 +420,12 @@ def main():
     ap.add_argument("--telemetry", default="",
                     help="bench telemetry sidecar (telemetry_config<N>.json)"
                          " — prints the measured prep/dispatch/harvest split")
+    ap.add_argument("--fleet-telemetry",
+                    default="perf/telemetry_config9.json",
+                    help="measured fleet-scaling sidecar (BENCH_CONFIG=9); "
+                         "when it exists the measured curve is printed and "
+                         "the pinned projection marked superseded "
+                         "('' disables the check)")
     args = ap.parse_args()
 
     if args.telemetry:
@@ -595,6 +651,19 @@ def main():
               f"{args.ndev} devices: {total:.0f} s")
         for row in rows:
             print(row)
+
+    # precedence rule: a MEASURED fleet-scaling curve (BENCH_CONFIG=9
+    # sidecar) supersedes the pinned projection above; the pins stay
+    # printed for comparison either way
+    if args.fleet_telemetry:
+        measured = load_measured_fleet(args.fleet_telemetry)
+        if measured:
+            print("\n" + format_measured_fleet(measured,
+                                               args.fleet_telemetry))
+        else:
+            print("\nno measured BENCH_CONFIG=9 fleet sidecar at "
+                  f"{args.fleet_telemetry} — the pinned projection above "
+                  "STANDS (run the fleet bench to supersede it)")
 
 
 if __name__ == "__main__":

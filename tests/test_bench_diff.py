@@ -129,3 +129,59 @@ def test_dir_mode_with_zero_pairs_errors_instead_of_passing(tmp_path,
     a.mkdir(), b.mkdir()
     assert bench_diff.main([str(a), str(b)]) == 2
     assert "no matching" in capsys.readouterr().err
+
+
+def _bits(v):
+    import struct
+    return struct.pack(">d", float(v)).hex()
+
+
+def _with_numerics(doc, values):
+    doc = copy.deepcopy(doc)
+    doc["numerics"] = {"engine_fingerprint": "f" * 16,
+                       "reduction_mode": "deterministic",
+                       "entries": len(values), "values": values}
+    return doc
+
+
+def test_gate_mode_requires_the_numerics_gate_to_run(tmp_path, capsys):
+    """--gate (the CI fleet gate): sidecars without same-fingerprint
+    numerics blocks mean the value-truth comparison silently never ran —
+    that must exit 2, not read green."""
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(_sidecar()))
+    b.write_text(json.dumps(_sidecar()))
+    # plain mode: green (no regressions)
+    assert bench_diff.main([str(a), str(b)]) == 0
+    # gate mode: the value gate never ran -> 2
+    assert bench_diff.main([str(a), str(b), "--gate"]) == 2
+    assert "never ran" in capsys.readouterr().err
+
+
+def test_gate_mode_passes_on_bit_identical_values(tmp_path):
+    vals = {"0x3": _bits(0.5), "0x5": _bits(0.625)}
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(_with_numerics(_sidecar(), vals)))
+    b.write_text(json.dumps(_with_numerics(_sidecar(), vals)))
+    assert bench_diff.main([str(a), str(b), "--gate"]) == 0
+
+
+def test_gate_mode_flags_value_drift_as_regression(tmp_path):
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(_with_numerics(
+        _sidecar(), {"0x3": _bits(0.5), "0x5": _bits(0.625)})))
+    b.write_text(json.dumps(_with_numerics(
+        _sidecar(), {"0x3": _bits(0.5), "0x5": _bits(0.6250000001)})))
+    assert bench_diff.main([str(a), str(b), "--gate"]) == 1
+
+
+def test_gate_mode_refuses_provenance_incomparable_pairs(tmp_path, capsys):
+    vals = {"0x3": _bits(0.5)}
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(_with_numerics(_sidecar(), vals)))
+    b.write_text(json.dumps(_with_numerics(
+        _sidecar(source="cpu_fallback"), vals)))
+    # plain mode reports but does not gate; --gate refuses outright
+    assert bench_diff.main([str(a), str(b)]) == 0
+    assert bench_diff.main([str(a), str(b), "--gate"]) == 2
+    assert "incomparable" in capsys.readouterr().err

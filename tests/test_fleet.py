@@ -1,0 +1,332 @@
+"""Fleet sweep plane (mplc_tpu/parallel/fleet.py) + mesh satellites.
+
+The headline invariant: a W-shard fleet sweep under
+MPLC_TPU_DETERMINISTIC_REDUCE=1 merges into a value ledger with ZERO ulp
+drift and Kendall tau-b == 1.0 against the single-shard run — across
+shard counts, across the transient/OOM fault ladder on one shard, and
+across a real OS-process boundary (workers at a DIFFERENT device count
+than this test process's 8-device mesh: the cross-topology bit-identity
+PR 14's deterministic mode earned, now exercised through the fleet
+merge)."""
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mplc_tpu.obs.numerics import diff_ledgers
+from mplc_tpu.parallel import fleet
+from mplc_tpu.parallel.mesh import make_2d_mesh, make_multihost_mesh
+
+REPO = Path(__file__).resolve().parents[1]
+
+SPEC = fleet.FleetSpec()  # titanic, 3 partners, 2 epochs, deterministic
+
+
+@pytest.fixture(scope="module")
+def ref_fleet(tmp_path_factory):
+    """The 1-shard deterministic reference (in-process, on the test
+    suite's 8-device mesh) every equality test diffs against."""
+    out = tmp_path_factory.mktemp("fleet_ref")
+    return fleet.run_fleet(SPEC, 1, str(out), inproc=True)
+
+
+# ---------------------------------------------------------------------------
+# mesh satellites
+# ---------------------------------------------------------------------------
+
+def test_make_2d_mesh_raises_valueerror_on_bad_grid():
+    """A mis-sized grid must raise ValueError naming the counts — a bare
+    assert vanishes under python -O and would hand shard_map a silently
+    wrong partition."""
+    import jax
+    n = len(jax.devices())
+    with pytest.raises(ValueError, match=f"needs 6 devices, have {n}"):
+        make_2d_mesh(3, 2, jax.devices())
+    # the happy path still builds
+    mesh = make_2d_mesh(n // 2, 2)
+    assert dict(mesh.shape) == {"coal": n // 2, "part": 2}
+
+
+def test_multihost_mesh_coal_spans_hosts_part_stays_local():
+    """The N x 8 fleet mesh: `coal` spans hosts, `part` stays inside one
+    host's device group (on the single-process test mesh every device
+    shares process_index 0, so the shape rule is what's checkable: 8
+    devices at part=2 -> [4, 2], part must divide the local count)."""
+    import jax
+    n = len(jax.devices())
+    mesh = make_multihost_mesh(part=2)
+    assert dict(mesh.shape) == {"coal": n // 2, "part": 2}
+    # every part-row holds devices of ONE host (process_index constant)
+    grid = mesh.devices
+    for row in grid:
+        assert len({getattr(d, "process_index", 0) for d in row}) == 1
+    with pytest.raises(ValueError, match="divide"):
+        make_multihost_mesh(part=3)
+    # deterministic layout: same call, same grid
+    again = make_multihost_mesh(part=2)
+    assert [[d.id for d in row] for row in again.devices] \
+        == [[d.id for d in row] for row in grid]
+
+
+# ---------------------------------------------------------------------------
+# slice planning + width pinning
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(partners=4):
+    sc = dataclasses.replace(SPEC, partners=partners,
+                             deterministic=False).build_scenario()
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+    return CharacteristicEngine(sc)
+
+
+def test_plan_slices_is_a_bucket_granular_disjoint_cover():
+    engine = _tiny_engine(partners=5)
+    from mplc_tpu.contrib.shapley import powerset_order
+    subsets = list(powerset_order(5))
+    for W in (1, 2, 3, 4):
+        slices = fleet.plan_slices(engine, subsets, W)
+        assert len(slices) == W
+        flat = [s for sl in slices for s in sl]
+        assert len(flat) == len(set(flat)) == len(subsets)  # disjoint cover
+        # bucket-granular: within each shard, every slot bucket's
+        # members are contiguous runs of the full bucket order
+        for sl in slices:
+            widths = [engine._slot_width(len(s)) for s in sl if len(s) > 1]
+            assert widths == sorted(widths)
+    # deterministic
+    assert fleet.plan_slices(engine, subsets, 3) \
+        == fleet.plan_slices(engine, subsets, 3)
+
+
+def test_pin_fleet_widths_keeps_slice_widths_at_full_sweep_plan():
+    """A shard slice smaller than the full bucket must still run at the
+    full sweep's batch width — identical programs across shards is what
+    lets the shared bank manifest serve W-1 of W shards."""
+    engine = _tiny_engine(partners=4)
+    from mplc_tpu.contrib.shapley import powerset_order
+    subsets = list(powerset_order(4))
+    pipe = engine._slot_pipe(3)  # merge mode: sizes 2+3 ride width 3
+    small = engine._planned_width(3, 3, pipe)
+    pinned = engine.pin_fleet_widths(subsets)
+    assert pinned, "expected a non-empty width plan"
+    full = engine._planned_width(3, 3, pipe)
+    # the full sweep has C(4,2)+C(4,3)=10 width-3 jobs; a 3-job slice
+    # must now bucket at the full plan's width, not its own smaller one
+    assert full == pinned[3] >= small
+    # the OOM ladder un-pins: a degraded cap re-buckets at the degraded
+    # width, never the stale plan's
+    engine._cap_halvings = 1
+    assert engine._planned_width(3, 3, pipe) <= full
+    engine._cap_halvings = 0
+
+
+# ---------------------------------------------------------------------------
+# the equality contract
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shards", [2, 4])
+def test_fleet_merge_bit_identical_to_single_shard(ref_fleet, tmp_path,
+                                                   shards):
+    """W-shard deterministic fleet == 1-shard run, bit for bit: zero ulp
+    on every subset, tau-b exactly 1.0, full coverage."""
+    res = fleet.run_fleet(SPEC, shards, str(tmp_path / f"w{shards}"),
+                          inproc=True, verify_against=ref_fleet.ledger)
+    assert res.values == ref_fleet.values
+    d = res.diff
+    assert d["comparable"] and not d["drift"]
+    assert d["ulp"]["max"] == 0 and d["kendall_tau"] == 1.0
+    assert d["common"] == len(SPEC.all_subsets())
+
+
+def test_fleet_equality_survives_fault_ladder_on_one_shard(ref_fleet,
+                                                           tmp_path):
+    """One shard rides the transient-retry AND OOM cap-halving rungs
+    (deterministic injection); the merged ledger must still be
+    bit-identical — recovery never changes v(S), even sharded."""
+    res = fleet.run_fleet(
+        SPEC, 2, str(tmp_path / "faulty"), inproc=True,
+        per_shard_env={1: {"MPLC_TPU_FAULT_PLAN":
+                           "transient@batch1,oom@batch2",
+                           "MPLC_TPU_RETRY_BACKOFF_SEC": "0"}},
+        verify_against=ref_fleet.ledger)
+    assert not res.diff["drift"] and res.diff["kendall_tau"] == 1.0
+    assert res.values == ref_fleet.values
+
+
+def test_fleet_merge_refuses_partial_and_overlap(ref_fleet, tmp_path):
+    out = tmp_path / "partial"
+    fleet.run_shard(SPEC, 0, 2, str(out))
+    # shard 1 never ran: no marker -> refusal naming the missing shard
+    with pytest.raises(fleet.FleetMergeError, match=r"shards \[1\]"):
+        fleet.merge_shard_results(SPEC, 2, str(out))
+    # force merges what exists — a deliberate partial (the operator's
+    # "those hosts are genuinely abandoned" override, same semantics as
+    # merge_shards.py --force)
+    values, merged, reports = fleet.merge_shard_results(
+        SPEC, 2, str(out), force=True)
+    assert 0 < len(values) < len(SPEC.all_subsets())
+    assert merged is not None and len(reports) == 1
+    # a stale done marker without a result file is also a refusal
+    (out / ".shard1.done").write_text("1")
+    with pytest.raises(fleet.FleetMergeError, match="no result file"):
+        fleet.merge_shard_results(SPEC, 2, str(out))
+
+
+def test_merge_ledgers_refuses_fingerprint_mismatch_and_overlap():
+    a = {"schema": 1, "engine_fingerprint": "aaaa", "meta": {},
+         "entries": {"0x3": {"value_bits": "00" * 8}}}
+    b_fp = {"schema": 1, "engine_fingerprint": "bbbb", "meta": {},
+            "entries": {"0x5": {"value_bits": "00" * 8}}}
+    with pytest.raises(fleet.FleetMergeError, match="different games"):
+        fleet.merge_ledgers([a, b_fp])
+    b_dup = {"schema": 1, "engine_fingerprint": "aaaa", "meta": {},
+             "entries": {"0x3": {"value_bits": "00" * 8}}}
+    with pytest.raises(fleet.FleetMergeError, match="more than one shard"):
+        fleet.merge_ledgers([a, b_dup])
+    merged = fleet.merge_ledgers([a, {"schema": 1,
+                                      "engine_fingerprint": "aaaa",
+                                      "meta": {},
+                                      "entries": {"0x5": {
+                                          "value_bits": "00" * 8}}}])
+    assert set(merged["entries"]) == {"0x3", "0x5"}
+    assert merged["meta"]["fleet_shards"] == 2
+
+
+def test_merged_cache_is_loadable_by_an_engine(ref_fleet):
+    """The coordinator's merged memo is a full valid engine cache:
+    load_cache accepts it (checksum + fingerprint) and a fully-memoized
+    evaluate() returns the merged values without training."""
+    path = os.path.join(ref_fleet.out_dir, "cache_merged.json")
+    assert os.path.exists(path)
+    with fleet._env_overlay({"MPLC_TPU_DETERMINISTIC_REDUCE": "1"}):
+        sc = SPEC.build_scenario()
+        from mplc_tpu.contrib.engine import CharacteristicEngine
+        engine = CharacteristicEngine(sc)
+    engine.load_cache(path)
+    before = engine.first_charac_fct_calls_count
+    got = engine.evaluate(SPEC.all_subsets())
+    assert engine.first_charac_fct_calls_count == before  # zero training
+    want = np.array([ref_fleet.values[s] for s in SPEC.all_subsets()])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fleet_subprocess_workers_cross_topology_equality(ref_fleet,
+                                                          tmp_path):
+    """The real process boundary: 2 worker SUBPROCESSES at ONE device
+    each (vs this suite's 8-device mesh) produce a merged ledger
+    bit-identical to the in-process 1-shard reference — process-axis
+    sharding composes with PR 14's cross-topology determinism. Also
+    checks the merge_shards-style completion markers landed."""
+    env = {"PYTHONPATH": str(REPO),
+           "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+           "HOME": os.environ.get("HOME", "/root"),
+           "JAX_PLATFORMS": "cpu",
+           "MPLC_TPU_SYNTH_SCALE":
+               os.environ.get("MPLC_TPU_SYNTH_SCALE", "0.02"),
+           "JAX_COMPILATION_CACHE_DIR": str(REPO / ".jax_cache")}
+    out = tmp_path / "subproc"
+    res = fleet.run_fleet(SPEC, 2, str(out), env=env, devices_per_shard=1,
+                          timeout=600, verify_against=ref_fleet.ledger)
+    assert not res.diff["drift"] and res.diff["kendall_tau"] == 1.0
+    assert res.values == ref_fleet.values
+    for i in range(2):
+        assert (out / f".shard{i}.done").exists()
+        rep = json.loads((out / f"result_shard{i}.json").read_text())
+        assert rep["devices"] == 1
+        assert rep["deterministic"] is True
+    assert (out / "ledger_merged.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# cross-shard service state
+# ---------------------------------------------------------------------------
+
+def test_publish_and_cluster_view(tmp_path):
+    d = str(tmp_path / "state")
+    fleet.publish_shard_state(d, "alpha", {"queue_depth": 3,
+                                           "jobs_pending": 5})
+    fleet.publish_shard_state(d, "beta", {"queue_depth": 1,
+                                          "jobs_pending": 1})
+    view = fleet.cluster_view(d)
+    assert view["live_shards"] == 2 and view["stale_shards"] == 0
+    assert view["cluster_queue_depth"] == 4
+    assert view["cluster_jobs_pending"] == 6
+    assert view["least_loaded"] == "beta"
+    # stale shards are flagged, kept visible, and excluded from totals
+    stale = os.path.join(d, "shard_alpha.json")
+    doc = json.loads(open(stale).read())
+    doc["ts"] -= 3600
+    with open(stale, "w") as f:
+        json.dump(doc, f)
+    view = fleet.cluster_view(d)
+    assert view["stale_shards"] == 1 and view["cluster_queue_depth"] == 1
+    assert view["shards"]["alpha"]["stale"] is True
+    # a shard that published closed=true (shutting down) is never a
+    # redirect target and leaves the live totals
+    fleet.publish_shard_state(d, "beta", {"queue_depth": 1,
+                                          "jobs_pending": 1,
+                                          "closed": True})
+    fleet.publish_shard_state(d, "gamma", {"queue_depth": 7,
+                                           "jobs_pending": 7})
+    view = fleet.cluster_view(d)
+    assert view["least_loaded"] == "gamma"
+    assert view["cluster_queue_depth"] == 7
+    # an empty/missing dir degrades to an empty view, never raises
+    empty = fleet.cluster_view(str(tmp_path / "nope"))
+    assert empty["live_shards"] == 0 and empty["least_loaded"] is None
+
+
+def test_service_publishes_fleet_state_and_healthz_block(tmp_path,
+                                                         monkeypatch):
+    from mplc_tpu.service import SweepService
+    d = str(tmp_path / "fleet_state")
+    monkeypatch.setenv("MPLC_TPU_FLEET_STATE_DIR", d)
+    monkeypatch.setenv("MPLC_TPU_FLEET_SHARD_ID", "alpha")
+    svc = SweepService(start=False)
+    try:
+        svc._publish_fleet_state(force=True)
+        hv = svc.health_view()
+        assert "fleet" in hv
+        assert hv["fleet"]["shard_id"] == "alpha"
+        assert "alpha" in hv["fleet"]["shards"]
+        assert hv["fleet"]["shards"]["alpha"]["queue_depth"] == 0
+    finally:
+        svc.shutdown(drain=False)
+
+
+def test_service_overload_carries_cluster_redirect_hint(tmp_path,
+                                                        monkeypatch):
+    import types
+
+    from mplc_tpu.service import SweepService
+    from mplc_tpu.service.scheduler import ServiceOverloaded
+    d = str(tmp_path / "fleet_state")
+    monkeypatch.setenv("MPLC_TPU_FLEET_STATE_DIR", d)
+    monkeypatch.setenv("MPLC_TPU_FLEET_SHARD_ID", "alpha")
+    fleet.publish_shard_state(d, "beta", {"queue_depth": 0,
+                                          "jobs_pending": 0})
+    svc = SweepService(start=False, max_pending=0)
+    try:
+        with pytest.raises(ServiceOverloaded) as exc:
+            svc.submit(types.SimpleNamespace(partners_count=3))
+        assert "beta" in str(exc.value)
+        assert exc.value.cluster is not None
+        assert exc.value.cluster["least_loaded"] == "beta"
+    finally:
+        svc.shutdown(drain=False)
+
+
+def test_service_without_fleet_dir_is_unchanged(monkeypatch):
+    from mplc_tpu.service import SweepService
+    monkeypatch.delenv("MPLC_TPU_FLEET_STATE_DIR", raising=False)
+    svc = SweepService(start=False)
+    try:
+        hv = svc.health_view()
+        assert "fleet" not in hv
+        svc._publish_fleet_state(force=True)  # no-op, no dir created
+    finally:
+        svc.shutdown(drain=False)

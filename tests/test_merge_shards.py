@@ -1,0 +1,105 @@
+"""Unit tests for scripts/merge_shards.py — previously only exercised
+end-to-end through test_e2e's CLI grid-shard test. These pin the
+refusal/override/warning semantics directly: partial-merge refusal on a
+missing `.shardI.done` marker, the --force override, the --keep
+double-count path, and the stitched csv's sort order."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT / "scripts"))
+
+import merge_shards  # noqa: E402
+
+
+def _make_sharded_dir(tmp_path, n_shards, mark_done=None, name="exp"):
+    """A <name>_shardedN folder with one csv per shard (rows deliberately
+    out of global order) and done markers for `mark_done` (default all)."""
+    import pandas as pd
+    folder = tmp_path / f"{name}_sharded{n_shards}"
+    folder.mkdir()
+    for i in range(n_shards):
+        # shard i owns scenario ids i, i+n, ... (the main.py slice rule);
+        # write them in DESCENDING order so the merge must re-sort
+        ids = sorted(range(i, 4 * n_shards, n_shards), reverse=True)
+        pd.DataFrame({
+            "scenario_id": ids,
+            "random_state": [1] * len(ids),
+            "value": [10 * x for x in ids],
+        }).to_csv(folder / f"results_shard{i}.csv", index=False)
+    for i in (range(n_shards) if mark_done is None else mark_done):
+        (folder / f".shard{i}.done").touch()
+    return folder
+
+
+def test_missing_done_marker_refuses_merge(tmp_path):
+    """csv presence is not completion: a shard whose marker is absent may
+    still be appending rows, and the merge must refuse loudly."""
+    folder = _make_sharded_dir(tmp_path, 2, mark_done=[0])
+    with pytest.raises(SystemExit) as exc:
+        merge_shards.main([str(folder)])
+    assert exc.value.code == 2
+    assert not (folder / "results.csv").exists()
+    # the shard csvs are untouched — nothing was renamed or consumed
+    assert (folder / "results_shard0.csv").exists()
+    assert (folder / "results_shard1.csv").exists()
+
+
+def test_force_overrides_missing_marker(tmp_path):
+    import pandas as pd
+    folder = _make_sharded_dir(tmp_path, 2, mark_done=[0])
+    assert merge_shards.main([str(folder), "--force"]) == 0
+    df = pd.read_csv(folder / "results.csv")
+    assert len(df) == 8  # both shard csvs merged despite the gap
+
+
+def test_merge_sorts_and_retires_shard_files(tmp_path):
+    """The stitched csv is globally sorted by (scenario_id, random_state)
+    even though every shard csv was written in descending order, and the
+    default (non --keep) path renames the shard csvs to *.merged and
+    removes the markers so a re-run can't inherit stale completion."""
+    import pandas as pd
+    folder = _make_sharded_dir(tmp_path, 2)
+    assert merge_shards.main([str(folder)]) == 0
+    df = pd.read_csv(folder / "results.csv")
+    assert df["scenario_id"].tolist() == sorted(df["scenario_id"].tolist())
+    assert df["scenario_id"].tolist() == list(range(8))
+    for i in range(2):
+        assert not (folder / f"results_shard{i}.csv").exists()
+        assert (folder / f"results_shard{i}.csv.merged").exists()
+        assert not (folder / f".shard{i}.done").exists()
+
+
+def test_keep_leaves_shard_files_and_warns_double_count(tmp_path, capsys):
+    """--keep leaves the shard csvs (and markers) in place — the
+    double-count hazard the help text warns about: the notebooks'
+    results*.csv glob would then read every row twice."""
+    folder = _make_sharded_dir(tmp_path, 2)
+    assert merge_shards.main([str(folder), "--keep"]) == 0
+    out = capsys.readouterr().out
+    assert "merged 2 shard files" in out
+    # the rename note is absent — nothing was retired
+    assert "renamed" not in out
+    for i in range(2):
+        assert (folder / f"results_shard{i}.csv").exists()
+        assert (folder / f".shard{i}.done").exists()
+    # the hazard is real: the glob the notebooks use now double-counts
+    import glob as _glob
+    assert len(_glob.glob(str(folder / "results*.csv"))) == 3
+
+
+def test_renamed_folder_requires_per_csv_markers(tmp_path):
+    """A folder that lost its _shardedN suffix can't know N — every csv
+    present must then carry its own marker, or the merge refuses."""
+    import shutil
+    folder = _make_sharded_dir(tmp_path, 2, mark_done=[0])
+    renamed = tmp_path / "copied_elsewhere"
+    shutil.copytree(folder, renamed)
+    with pytest.raises(SystemExit) as exc:
+        merge_shards.main([str(renamed)])
+    assert exc.value.code == 2
+    (renamed / ".shard1.done").touch()
+    assert merge_shards.main([str(renamed)]) == 0

@@ -268,3 +268,68 @@ def test_telemetry_service_row_loads_and_degrades(tmp_path):
     old.write_text(json.dumps({
         "metric": "m", "report": {"wallclock": {"evaluate_s": 290.0}}}))
     assert proj.load_telemetry_service(str(old)) == {}
+
+
+def _fleet_sidecar_doc():
+    return {
+        "metric": "fleet_sweep_titanic_10partners_8epochs_8dev_wallclock"
+                  "_cpumesh",
+        "wallclock_s": 4.0, "devices": 8,
+        "fleet": {
+            "provenance": "cpu_mesh",
+            "scaling_basis": "max_shard_wallclock",
+            "points": [
+                {"devices": 1, "shards": 1, "fleet_wallclock_s": 12.0,
+                 "speedup_vs_1": 1.0},
+                {"devices": 8, "shards": 8, "fleet_wallclock_s": 4.0,
+                 "speedup_vs_1": 3.0}],
+            "equality": {"shards": 4, "drift": False,
+                         "ulp": {"max": 0}, "kendall_tau": 1.0},
+        },
+    }
+
+
+def test_load_measured_fleet_accessor_degrades(tmp_path):
+    """{} for an absent sidecar, an invalid one, or one without fleet
+    points (an ordinary config-1 sidecar) — only a real measured curve
+    triggers the precedence rule."""
+    import json
+    assert proj.load_measured_fleet(str(tmp_path / "none.json")) == {}
+    bad = tmp_path / "bad.json"
+    bad.write_text("{torn")
+    assert proj.load_measured_fleet(str(bad)) == {}
+    plain = tmp_path / "telemetry_config1.json"
+    plain.write_text(json.dumps({"metric": "m", "wallclock_s": 1.0}))
+    assert proj.load_measured_fleet(str(plain)) == {}
+    measured = tmp_path / "telemetry_config9.json"
+    measured.write_text(json.dumps(_fleet_sidecar_doc()))
+    m = proj.load_measured_fleet(str(measured))
+    assert m["provenance"] == "cpu_mesh"
+    assert m["points"][-1]["devices"] == 8
+    out = proj.format_measured_fleet(m, str(measured))
+    assert "SUPERSEDED" in out
+    assert "not a TPU number" in out     # cpu_mesh provenance flagged
+    assert "tau=1.0" in out
+
+
+def test_projection_precedence_rule_in_main(tmp_path, capsys, monkeypatch):
+    """The precedence rule end to end: without a measured BENCH_CONFIG=9
+    sidecar the pinned projection STANDS; with one it is printed and
+    marked SUPERSEDED (the projection pins stay printed either way)."""
+    import json
+    monkeypatch.chdir(ROOT)
+    monkeypatch.setattr(sys, "argv", [
+        "project_v5e8.py",
+        "--fleet-telemetry", str(tmp_path / "none.json")])
+    proj.main()
+    out = capsys.readouterr().out
+    assert "projected 10-partner sweep" in out    # the pins still print
+    assert "STANDS" in out and "SUPERSEDED" not in out
+    measured = tmp_path / "telemetry_config9.json"
+    measured.write_text(json.dumps(_fleet_sidecar_doc()))
+    monkeypatch.setattr(sys, "argv", [
+        "project_v5e8.py", "--fleet-telemetry", str(measured)])
+    proj.main()
+    out = capsys.readouterr().out
+    assert "projected 10-partner sweep" in out    # pins kept for compare
+    assert "MEASURED fleet scaling" in out and "SUPERSEDED" in out
