@@ -404,7 +404,14 @@ def _spawn_cpu_fallback() -> int:
             "MPLC_TPU_NUMERICS_LEDGER",
             "MPLC_TPU_FLIGHT_RECORDER_DIR",
             "MPLC_TPU_FLIGHT_RECORDER_SIZE",
-            "MPLC_TPU_CHROME_TRACE_FILE"):
+            "MPLC_TPU_CHROME_TRACE_FILE",
+            # the child is not a fleet shard: inheriting the parent's
+            # fleet identity would stamp its trace records into the
+            # parent run's merged timeline, and a peers list would make
+            # the child scrape shards it has no business aggregating
+            "MPLC_TPU_FLEET_RUN_ID",
+            "MPLC_TPU_FLEET_COORD_TS",
+            "MPLC_TPU_FLEET_PEERS"):
         env.pop(knob, None)
     env.update(
         # A clean PYTHONPATH drops the ambient accelerator registration,
@@ -1144,6 +1151,17 @@ def bench_fleet(epochs, dtype):
         fleet_wall = max(res.per_shard_sweep_s)
         if nd == points[0] and nd == 1:
             base_wall = fleet_wall
+        # fleet-health shape of the point, beyond the scaling number:
+        # straggler ratio (max/median shard sweep — 1.0 is a perfectly
+        # balanced fleet), raw spread, and shard-count-normalized
+        # throughput (coalitions per shard-second — the number that
+        # should hold flat as W grows if sharding is efficient)
+        sweeps = sorted(res.per_shard_sweep_s)
+        mid = (sweeps[len(sweeps) // 2] if len(sweeps) % 2 else
+               (sweeps[len(sweeps) // 2 - 1] + sweeps[len(sweeps) // 2]) / 2)
+        straggler = (sweeps[-1] / mid) if mid > 0 else None
+        coal_per_shard_s = (len(res.values) / (W * fleet_wall)
+                            if fleet_wall > 0 else None)
         point = {
             "devices": nd, "shards": W,
             "devices_per_shard": dev_per_shard or "all",
@@ -1154,6 +1172,9 @@ def bench_fleet(epochs, dtype):
             "per_shard_setup_s": [
                 r.get("setup_s") for r in res.shard_reports],
             "concurrent": concurrent,
+            "straggler_ratio": straggler,
+            "sweep_s_spread": sweeps[-1] - sweeps[0],
+            "coalitions_per_shard_s": coal_per_shard_s,
             "speedup_vs_1": (base_wall / fleet_wall
                              if base_wall else None),
             "coalitions": len(res.values),
@@ -1243,6 +1264,12 @@ def bench_fleet(epochs, dtype):
                "recorded beside it)")),
         "points": curve,
         "equality": equality,
+        # headline fleet-health rows (top point + equality tau) for the
+        # bench_diff gate: regressions in shard balance or normalized
+        # throughput fail the diff even when the critical path holds
+        "straggler_ratio": top["straggler_ratio"],
+        "coalitions_per_shard_s": top["coalitions_per_shard_s"],
+        "cross_shard_rank_tau": equality.get("kendall_tau"),
     }
     _write_telemetry({"metric": metric,
                       "wallclock_s": top["fleet_wallclock_s"],

@@ -366,10 +366,32 @@ NUMERICS_LEDGER_ENV = "MPLC_TPU_NUMERICS_LEDGER"
 #                             in ServiceOverloaded redirect hints. Unset
 #                             = single-process behavior, byte-identical.
 #   MPLC_TPU_FLEET_SHARD_ID   this process's shard name in the state dir
-#                             (default pid<pid>)
+#                             (default pid<pid>); also stamped as
+#                             `fleet_shard` on every trace record
+# Fleet observability plane (obs/fleet_view.py, obs/trace.py): pure
+# read-side telemetry — none of these changes a computed number:
+#   MPLC_TPU_FLEET_RUN_ID     the coordinator-minted fleet run id;
+#                             injected into every worker env and stamped
+#                             as `fleet_run` on every span/event record,
+#                             so W per-shard trace streams correlate by
+#                             construction (scripts/fleet_trace_merge.py)
+#   MPLC_TPU_FLEET_COORD_TS   the coordinator's spawn-time clock reading
+#                             for one shard; the worker echoes it in its
+#                             result JSON beside its own start/end
+#                             readings — the clock-offset handshake that
+#                             rebases shard traces onto the coordinator
+#                             clock (midpoint rule)
+#   MPLC_TPU_FLEET_PEERS      comma-separated host:port /varz endpoints
+#                             the fleet collector scrapes into the
+#                             aggregated /fleet/metrics + /fleet/varz
+#                             view (with MPLC_TPU_METRICS_TOKEN as the
+#                             operator credential)
 FLEET_SHARDS_ENV = "MPLC_TPU_FLEET_SHARDS"
 FLEET_STATE_DIR_ENV = "MPLC_TPU_FLEET_STATE_DIR"
 FLEET_SHARD_ID_ENV = "MPLC_TPU_FLEET_SHARD_ID"
+FLEET_RUN_ID_ENV = "MPLC_TPU_FLEET_RUN_ID"
+FLEET_COORD_TS_ENV = "MPLC_TPU_FLEET_COORD_TS"
+FLEET_PEERS_ENV = "MPLC_TPU_FLEET_PEERS"
 
 
 _barrier_degradation_warned = False
@@ -566,5 +588,12 @@ ENV_KNOBS = {
     "MPLC_TPU_FLIGHT_RECORDER_DIR": "sidecar",
     "MPLC_TPU_FLIGHT_RECORDER_SIZE": "sidecar",
     "MPLC_TPU_CHROME_TRACE_FILE": "sidecar",
+    # the fleet observability knobs are trace correlation + collector
+    # plumbing: read-side only, but a CPU-fallback child must not
+    # inherit the parent's fleet identity (its records would masquerade
+    # as a shard's) or scrape peers on its own
+    "MPLC_TPU_FLEET_RUN_ID": "sidecar",
+    "MPLC_TPU_FLEET_COORD_TS": "sidecar",
+    "MPLC_TPU_FLEET_PEERS": "sidecar",
     "MPLC_TPU_DATA_DIR": "ambient",
 }

@@ -24,7 +24,15 @@ with three routes:
              queue depth and journal status.
   /varz      Full JSON state snapshot: the metrics registry plus every
              registered varz provider (service job table, program-bank
-             contents when the bank module is loaded).
+             contents when the bank module is loaded), a numerics block,
+             and — when the process participates in a fleet — the
+             cross-shard cluster view.
+  /fleet/metrics, /fleet/varz
+             The AGGREGATED cluster view (obs/fleet_view.py): metrics
+             merged exactly across every shard the collector can reach
+             (HTTP peers, published state dir), served with the same
+             credential model — Prometheus text operator-only, the JSON
+             view tenant-redacted per row.
 
 With the env var UNSET nothing happens: no socket, no thread — the
 instrumented paths cost exactly what they cost before. A plain port
@@ -35,7 +43,8 @@ additionally gates /metrics and /varz behind bearer credentials — the
 master token for the operator, `tenant_token(master, name)`-derived
 credentials for tenants, whose /varz view has every other tenant's rows
 redacted (`redact_varz`) — the first concrete step toward
-mutually-distrusting consortium tenants sharing one telemetry plane. Port 0 binds an ephemeral port (tests; the bound port is on
+mutually-distrusting consortium tenants sharing one telemetry plane.
+Port 0 binds an ephemeral port (tests; the bound port is on
 `TelemetryServer.port` and in the start-up log line). The server is a
 process singleton: the first `start()` wins, later calls return it.
 
@@ -179,6 +188,26 @@ def varz_view() -> dict:
         }
     except Exception as e:
         out["numerics"] = {"error": str(e)[:200]}
+    # fleet block (mirrors the /healthz block PR 15 added there): the
+    # cross-shard cluster view + this process's shard identity and its
+    # publish-failure counter, present whenever the process participates
+    # in a fleet (state dir configured). Shard ids/queue rows are
+    # identity-bearing in a consortium — redact_varz hashes them for
+    # tenant-scoped viewers (queue depths stay scalars).
+    try:
+        from .. import constants as _c
+        state_dir = os.environ.get(_c.FLEET_STATE_DIR_ENV)
+        if state_dir:
+            from ..parallel.fleet import cluster_view
+            fv = cluster_view(state_dir)
+            fv["shard_id"] = os.environ.get(_c.FLEET_SHARD_ID_ENV)
+            cnt = out["metrics"].get("counters", {}) if isinstance(
+                out.get("metrics"), dict) else {}
+            fv["state_publish_errors"] = cnt.get(
+                "fleet.state_publish_errors", 0)
+            out["fleet"] = fv
+    except Exception as e:
+        out["fleet"] = {"error": str(e)[:200]}
     return out
 
 
@@ -280,7 +309,12 @@ def redact_varz(doc, viewer: "str | None" = None,
       - the live tier's `live_games` block (tenant-keyed game rows):
         non-viewer rows collapse to a hashed-tenant tag plus the
         activity scalars, with the journal PATH dropped — a filesystem
-        path is operator detail, not a co-tenant's business.
+        path is operator detail, not a co-tenant's business;
+      - fleet views (`shards` row tables, `least_loaded`, `shard_id`,
+        `peer`): shard identities/endpoints are deployment topology and
+        hash to opaque `shard-` tags, while queue/freshness SCALARS stay
+        readable — a tenant may reason about cluster load, never about
+        which host is which.
 
     `key` (the master token) makes the hashed tags HMAC-keyed — see
     `_tenant_tag`."""
@@ -331,6 +365,25 @@ def redact_varz(doc, viewer: "str | None" = None,
                                "queries": row.get("queries"),
                                "redacted": True})
                         for t, row in val.items()}
+                elif (k == "shards" and isinstance(val, dict) and val
+                      and all(isinstance(r, dict)
+                              for r in val.values())):
+                    # fleet views (the /varz fleet block, /fleet/varz):
+                    # shard ids are deployment topology — hashed for
+                    # tenant viewers, while the rows' queue/freshness
+                    # SCALARS stay readable (a tenant may reason about
+                    # cluster load, not about which host is which)
+                    out[k] = {
+                        _opaque_tag(s, key, "shard"):
+                        {**walk(row),
+                         **({"shard": _opaque_tag(row["shard"], key,
+                                                  "shard")}
+                            if isinstance(row.get("shard"), str)
+                            else {})}
+                        for s, row in val.items()}
+                elif (k in ("least_loaded", "shard_id", "peer")
+                      and isinstance(val, str)):
+                    out[k] = _opaque_tag(val, key, "shard")
                 elif isinstance(k, str) and "tenant=" in k:
                     out[_redact_key(k)] = walk(val)
                 else:
@@ -476,8 +529,43 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                                   key=os.environ.get(METRICS_TOKEN_ENV))
             self._reply(200, json.dumps(doc, default=str).encode(),
                         "application/json")
+        elif path in ("/fleet/varz", "/fleet/metrics"):
+            # the aggregated cluster view (obs/fleet_view.py): same
+            # credential model as the per-process routes — Prometheus
+            # text is operator-only, the JSON view serves tenants with
+            # every aggregated row under the PR-12 redaction walk
+            role, viewer = self._auth_role(query)
+            if role == "denied" or (path == "/fleet/metrics"
+                                    and role not in ("open", "operator")):
+                return self._deny()
+            from . import fleet_view
+            coll = fleet_view.get_or_create_collector()
+            if coll is None:
+                return self._reply(
+                    404, b"no fleet collector configured (set "
+                    b"MPLC_TPU_FLEET_PEERS or MPLC_TPU_FLEET_STATE_DIR, "
+                    b"or install one via fleet_view.set_collector)\n",
+                    "text/plain")
+            try:
+                if path == "/fleet/metrics":
+                    merged = coll.collect().get("merged") or {}
+                    body = fleet_view.fleet_metrics_text(merged).encode()
+                    self._reply(200, body, "text/plain; version=0.0.4")
+                else:
+                    doc = coll.fleet_varz()
+                    if role == "tenant":
+                        doc = redact_varz(
+                            doc, viewer,
+                            key=os.environ.get(METRICS_TOKEN_ENV))
+                    self._reply(200,
+                                json.dumps(doc, default=str).encode(),
+                                "application/json")
+            except Exception as e:  # collector failure is a 503, not 500
+                self._reply(503, json.dumps(
+                    {"error": str(e)[:500]}).encode(), "application/json")
         elif path == "/":
-            self._reply(200, b"mplc_tpu telemetry: /metrics /healthz /varz\n",
+            self._reply(200, b"mplc_tpu telemetry: /metrics /healthz "
+                        b"/varz /fleet/metrics /fleet/varz\n",
                         "text/plain")
         else:
             self._reply(404, b"not found\n", "text/plain")

@@ -1,0 +1,538 @@
+"""Fleet observability plane: one view of a W-shard run.
+
+PR 15 made execution fleet-scale (parallel/fleet.py shards one sweep
+across OS processes/hosts); this module makes the RESULTING system
+observable as one thing instead of W disjoint ones. Three instruments:
+
+  FleetCollector      merges every shard's metrics into a cluster
+                      snapshot. Sources, all optional and composable:
+                      HTTP peers (each shard's token-authenticated
+                      /varz), the published fleet state dir (serverless
+                      service shards — scheduler._publish_fleet_state
+                      embeds its metrics snapshot there), and a fleet
+                      sweep out_dir (each worker's result_shardI.json
+                      carries its final snapshot). Because every
+                      histogram shares metrics.LOG_BUCKET_BOUNDS, the
+                      merge (`metrics.merge_snapshots`) is EXACT: the
+                      cluster p99 of `service.queue_wait_sec{tenant=t0}`
+                      equals the quantile over the pooled raw samples at
+                      bucket granularity — not an average of per-shard
+                      quantiles, which is statistically meaningless.
+                      Served as /fleet/metrics + /fleet/varz by
+                      obs/export.py, with the PR-12 tenant redaction
+                      applied to every aggregated row.
+
+  merge_fleet_traces  one Perfetto timeline from a fleet out_dir: the
+                      coordinator's span stream plus every shard's,
+                      each shard REBASED onto the coordinator clock
+                      (midpoint rule over the 4-timestamp handshake:
+                      coordinator spawn/done-seen vs worker start/end,
+                      NTP-style — symmetric spawn/teardown latency
+                      cancels, cross-host skew does not survive) and
+                      drawn as its own track group (one pid per shard),
+                      with flow arrows linking each `fleet.shard`
+                      dispatch event to that shard's `fleet.shard_run`
+                      root span. CLI: scripts/fleet_trace_merge.py.
+
+  cluster_snapshot    the one-call convenience the incident bundler and
+                      the fleet selfcheck use.
+
+Everything here is read-side: no instrument in this module changes a
+computed number, and a missing source degrades to an error row, never an
+exception into the caller.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+from . import metrics as obs_metrics
+from . import trace as obs_trace
+
+logger = __import__("logging").getLogger("mplc_tpu")
+
+# comma-separated host:port (or http://...) peers the collector scrapes;
+# sidecar-class knob (constants.ENV_KNOBS) — observability only
+FLEET_PEERS_ENV = "MPLC_TPU_FLEET_PEERS"
+
+# the SLO histograms the cluster rollup surfaces as first-class quantile
+# rows (everything else still merges — these just get the shortcut view)
+_SLO_HISTOGRAMS = ("service.queue_wait_sec",
+                   "service.time_to_first_value_sec",
+                   "service.slice_sec", "live.query_sec")
+
+_KEY_RE = re.compile(r"^(?P<name>[^{]+)\{(?P<labels>.*)\}$")
+_TENANT_IN_KEY_RE = re.compile(r"tenant=([^},]*)")
+
+
+def _parse_key(key: str) -> tuple:
+    """(base name, labels dict) for a registry `name{k=v,...}` key."""
+    m = _KEY_RE.match(key)
+    if m is None:
+        return key, {}
+    labels = {}
+    for part in m.group("labels").split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return m.group("name"), labels
+
+
+def _read_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the collector
+# ---------------------------------------------------------------------------
+
+class FleetCollector:
+    """Scrape/read every shard's metrics into one cluster snapshot.
+
+    `peers`      list of `host:port` (or full `http://` URLs): each is
+                 GET `<peer>/varz` with the operator bearer token — the
+                 collector is an OPERATOR instrument; per-tenant
+                 redaction happens when the AGGREGATE is served, not on
+                 the shard hop.
+    `state_dir`  the fleet state dir (`MPLC_TPU_FLEET_STATE_DIR`):
+                 rows ride `cluster_view`'s stale rule as per-shard
+                 freshness flags, and shards that embedded a metrics
+                 snapshot in their published state contribute to the
+                 merge without any HTTP surface (serverless mode).
+    `out_dir`    a fleet sweep output dir: result_shardI.json snapshots
+                 (subprocess fleets — each worker had its own registry).
+    """
+
+    def __init__(self, peers: "list | None" = None,
+                 token: "str | None" = None,
+                 state_dir: "str | None" = None,
+                 out_dir: "str | None" = None,
+                 stale_sec: float = 30.0, timeout_s: float = 5.0):
+        self.peers = list(peers or [])
+        self.token = token
+        self.state_dir = state_dir
+        self.out_dir = out_dir
+        self.stale_sec = float(stale_sec)
+        self.timeout_s = float(timeout_s)
+
+    # -- per-source readers -------------------------------------------------
+
+    def _scrape_peer(self, peer: str) -> dict:
+        import urllib.request
+        url = peer if "://" in peer else f"http://{peer}"
+        req = urllib.request.Request(url.rstrip("/") + "/varz")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                doc = json.loads(r.read().decode())
+            row = {"source": "http", "peer": peer, "ok": True,
+                   "fresh": True, "pid": doc.get("pid"),
+                   "metrics": doc.get("metrics")}
+            sched = doc.get("scheduler")
+            if isinstance(sched, dict):
+                for k in ("queue_depth", "jobs_pending", "closed"):
+                    if k in sched:
+                        row[k] = sched[k]
+            return row
+        except Exception as e:  # noqa: BLE001 — a dead peer is a row
+            return {"source": "http", "peer": peer, "ok": False,
+                    "fresh": False, "error": str(e)[:200]}
+
+    def _state_rows(self) -> tuple:
+        from ..parallel import fleet as _fleet
+        view = _fleet.cluster_view(self.state_dir,
+                                   stale_sec=self.stale_sec,
+                                   include_metrics=True)
+        rows = {}
+        for sid, doc in (view.get("shards") or {}).items():
+            rows[sid] = {"source": "state_dir", "ok": True,
+                         "fresh": not doc.get("stale"),
+                         "age_sec": doc.get("age_sec"),
+                         "queue_depth": doc.get("queue_depth"),
+                         "jobs_pending": doc.get("jobs_pending"),
+                         "closed": doc.get("closed"),
+                         "metrics": doc.get("metrics")}
+        return rows, view
+
+    def _result_rows(self) -> dict:
+        rows = {}
+        try:
+            names = sorted(os.listdir(self.out_dir))
+        except OSError:
+            return rows
+        for name in names:
+            m = re.fullmatch(r"result_shard(\d+)\.json", name)
+            if not m:
+                continue
+            doc = _read_json(os.path.join(self.out_dir, name))
+            if not isinstance(doc, dict):
+                continue
+            i = int(m.group(1))
+            done = os.path.exists(
+                os.path.join(self.out_dir, f".shard{i}.done"))
+            sid = ((doc.get("fleet") or {}).get("shard_id")
+                   or f"shard{i}")
+            rows[sid] = {"source": "result", "ok": True, "fresh": done,
+                         "shard_index": i,
+                         "run_id": (doc.get("fleet") or {}).get("run_id"),
+                         "sweep_s": doc.get("sweep_s"),
+                         "coalitions": len(doc.get("subsets") or []),
+                         "metrics": doc.get("metrics")}
+        return rows
+
+    # -- assembly -----------------------------------------------------------
+
+    def collect(self) -> dict:
+        """One cluster snapshot: per-shard rows (freshness-flagged),
+        the exact merged metrics, per-tenant SLO quantile shortcuts,
+        summed device-seconds metering, and the state-dir cluster
+        totals when available."""
+        with obs_trace.span("fleet.collect",
+                            sources=sum(1 for s in (self.peers,
+                                                    self.state_dir,
+                                                    self.out_dir) if s)):
+            shards: dict = {}
+            cluster = None
+            if self.out_dir:
+                shards.update(self._result_rows())
+            if self.state_dir:
+                rows, cluster = self._state_rows()
+                shards.update(rows)
+            for peer in self.peers:
+                row = self._scrape_peer(peer)
+                shards[f"peer:{peer}"] = row
+            snaps = []
+            for sid, row in shards.items():
+                obs_trace.event("fleet.scrape", shard=sid,
+                                source=row.get("source"),
+                                ok=bool(row.get("ok")))
+                snap = row.pop("metrics", None)
+                if isinstance(snap, dict):
+                    row["has_metrics"] = True
+                    snaps.append(snap)
+            merged = obs_metrics.merge_snapshots(snaps)
+            out = {
+                "ts": time.time(),
+                "shards": shards,
+                "shard_count": len(shards),
+                "fresh_shards": sum(1 for r in shards.values()
+                                    if r.get("fresh")),
+                "merged_sources": len(snaps),
+                "merged": merged,
+                "slo": _slo_quantiles(merged),
+            }
+            out.update(_device_seconds(merged))
+            if cluster is not None:
+                out["cluster"] = {k: v for k, v in cluster.items()
+                                  if k != "shards"}
+        return out
+
+    def fleet_varz(self) -> dict:
+        """The /fleet/varz body."""
+        return {"pid": os.getpid(), "collector": {
+            "peers": list(self.peers), "state_dir": self.state_dir,
+            "out_dir": self.out_dir, "stale_sec": self.stale_sec},
+            **self.collect()}
+
+
+def _slo_quantiles(merged: dict) -> dict:
+    """Cluster-true quantile shortcuts for the SLO histograms, keyed by
+    their full (tenant-labeled) registry keys — the rows an operator
+    dashboards without digging bucket arrays out of `merged`."""
+    out = {}
+    for key, h in (merged.get("histograms") or {}).items():
+        base, _labels = _parse_key(key)
+        if base in _SLO_HISTOGRAMS and h.get("count"):
+            out[key] = {"count": h["count"], "mean": h.get("mean"),
+                        "p50": h.get("p50"), "p95": h.get("p95"),
+                        "p99": h.get("p99")}
+    return out
+
+
+def _device_seconds(merged: dict) -> dict:
+    """Summed device-seconds metering across shards: the fleet bill."""
+    per_tenant: dict = {}
+    total = 0.0
+    for key, v in (merged.get("counters") or {}).items():
+        base, labels = _parse_key(key)
+        if base == "service.device_seconds":
+            total += float(v or 0.0)
+            tenant = labels.get("tenant")
+            if tenant is not None:
+                per_tenant[tenant] = (per_tenant.get(tenant, 0.0)
+                                      + float(v or 0.0))
+    out: dict = {"device_seconds_total": total}
+    if per_tenant:
+        out["tenant_device_seconds"] = per_tenant
+    return out
+
+
+# -- module-global collector (what /fleet/* serves) --------------------------
+
+_collector: "FleetCollector | None" = None
+
+
+def set_collector(c: "FleetCollector | None") -> None:
+    global _collector
+    _collector = c
+
+
+def active_collector() -> "FleetCollector | None":
+    return _collector
+
+
+def collector_from_env() -> "FleetCollector | None":
+    """A collector from the ambient knobs, or None when no source is
+    configured: `MPLC_TPU_FLEET_PEERS` (comma-separated /varz peers,
+    scraped with the `MPLC_TPU_METRICS_TOKEN` operator credential) and
+    the fleet state dir."""
+    from .. import constants
+    peers = [p.strip() for p in
+             (os.environ.get(FLEET_PEERS_ENV) or "").split(",")
+             if p.strip()]
+    state_dir = os.environ.get(constants.FLEET_STATE_DIR_ENV)
+    if not peers and not state_dir:
+        return None
+    return FleetCollector(
+        peers=peers, state_dir=state_dir,
+        token=os.environ.get("MPLC_TPU_METRICS_TOKEN"))
+
+
+def get_or_create_collector() -> "FleetCollector | None":
+    """The installed collector, else one built from env (NOT installed —
+    env may change between requests; cheap to rebuild)."""
+    return _collector if _collector is not None else collector_from_env()
+
+
+def cluster_snapshot(out_dir: "str | None" = None,
+                     state_dir: "str | None" = None) -> dict:
+    """One-call cluster snapshot over whatever sources exist — the
+    incident bundler's and the selfcheck's entry point. Never raises."""
+    try:
+        return FleetCollector(out_dir=out_dir,
+                              state_dir=state_dir).collect()
+    except Exception as e:  # noqa: BLE001 — postmortem helper
+        return {"error": str(e)[:500]}
+
+
+# ---------------------------------------------------------------------------
+# /fleet/metrics rendering (Prometheus text over the MERGED snapshot)
+# ---------------------------------------------------------------------------
+
+def fleet_metrics_text(merged: dict) -> str:
+    """Prometheus text exposition of a merged snapshot. Series are
+    prefixed `mplc_fleet_` so a scraper federating both the per-shard
+    /metrics and the aggregate never double-counts a sample."""
+    from . import export as _export
+    lines = []
+    typed: set = set()
+
+    def emit(key, kind, render):
+        name, labels = _parse_key(key)
+        pname, plabels = _export._prom_parts(name, labels)
+        pname = "mplc_fleet_" + pname[len("mplc_"):]
+        if pname not in typed:
+            typed.add(pname)
+            lines.append(f"# TYPE {pname} {kind}")
+        render(pname, plabels)
+
+    for key, v in sorted((merged.get("counters") or {}).items()):
+        emit(key, "counter", lambda n, la, v=v: lines.append(
+            f"{n}{_export._label_str(la)} {_export._fmt(v)}"))
+    for key, v in sorted((merged.get("gauges") or {}).items()):
+        if v is None:
+            continue
+        emit(key, "gauge", lambda n, la, v=v: lines.append(
+            f"{n}{_export._label_str(la)} {_export._fmt(v)}"))
+    for key, h in sorted((merged.get("histograms") or {}).items()):
+        bc = h.get("bucket_counts") or []
+
+        def hist(n, la, h=h, bc=bc):
+            cum = 0
+            for bound, c in zip(obs_metrics.LOG_BUCKET_BOUNDS, bc):
+                cum += c
+                lines.append(
+                    f"{n}_bucket"
+                    f"{_export._label_str(dict(la, le=_export._fmt(bound)))}"
+                    f" {cum}")
+            cum += bc[-1] if len(bc) > len(obs_metrics.LOG_BUCKET_BOUNDS) \
+                else 0
+            lines.append(f'{n}_bucket'
+                         f'{_export._label_str(dict(la, le="+Inf"))} {cum}')
+            lines.append(f"{n}_sum{_export._label_str(la)} "
+                         f"{_export._fmt(h.get('sum') or 0.0)}")
+            lines.append(f"{n}_count{_export._label_str(la)} "
+                         f"{int(h.get('count') or 0)}")
+        emit(key, "histogram", hist)
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# merged Perfetto timeline
+# ---------------------------------------------------------------------------
+
+def _clock_offset(manifest: dict, result: "dict | None",
+                  shard: int) -> float:
+    """Seconds to ADD to a shard's timestamps to land them on the
+    coordinator clock. Midpoint rule over the handshake's four
+    timestamps — coordinator spawn (s) / done-seen (d) vs worker start
+    (ws) / end (we):
+
+        offset = ((s - ws) + (d - we)) / 2
+
+    With zero skew, spawn->start latency and end->done-seen latency
+    enter with opposite signs and cancel to their asymmetry; with skew,
+    the skew survives intact (it appears identically in both terms).
+    Degrades to the one-sided `s - ws` when the run has no done-seen
+    record (crashed shard), and to 0 with no handshake at all."""
+    clock = (result or {}).get("clock") or {}
+    spawn = (manifest.get("spawn_ts") or {}).get(str(shard))
+    if spawn is None:
+        spawn = clock.get("coord_spawn_ts")
+    done = (manifest.get("done_seen_ts") or {}).get(str(shard))
+    ws = clock.get("worker_start_ts")
+    we = clock.get("worker_end_ts")
+    if spawn is not None and ws is not None:
+        if done is not None and we is not None:
+            return ((spawn - ws) + (done - we)) / 2.0
+        return float(spawn) - float(ws)
+    return 0.0
+
+
+def merge_fleet_traces(out_dir: str) -> dict:
+    """One Chrome-trace document from a fleet out_dir: the coordinator
+    stream (trace_coordinator.jsonl) on pid 1, each shard's stream
+    (trace_shardI.jsonl) rebased onto the coordinator clock and drawn
+    as its own process-level track group (pid 10+I, named via
+    process_name metadata), plus flow arrows from every `fleet.shard`
+    dispatch event to the matching shard's `fleet.shard_run` root span.
+
+    Returns {trace, shard_tracks, flow_links, offsets, records,
+    torn_lines}; `trace` loads directly in https://ui.perfetto.dev."""
+    from . import chrome_trace
+    manifest = _read_json(
+        os.path.join(out_dir, "fleet_trace_manifest.json")) or {}
+    torn_total = 0
+    coord_path = os.path.join(out_dir, "trace_coordinator.jsonl")
+    coord_records: list = []
+    if os.path.exists(coord_path):
+        coord_records, torn = chrome_trace.read_jsonl(coord_path)
+        torn_total += torn
+        # inproc fleets: the coordinator's collector saw the shards'
+        # records too; those live in the per-shard files (the writer
+        # drops them, but tolerate older coordinator files)
+        coord_records = [r for r in coord_records
+                         if "fleet_shard" not in r]
+    shard_streams: dict = {}
+    offsets: dict = {}
+    try:
+        names = sorted(os.listdir(out_dir))
+    except OSError:
+        names = []
+    for name in names:
+        m = re.fullmatch(r"trace_shard(\d+)\.jsonl", name)
+        if not m:
+            continue
+        i = int(m.group(1))
+        records, torn = chrome_trace.read_jsonl(
+            os.path.join(out_dir, name))
+        torn_total += torn
+        result = _read_json(
+            os.path.join(out_dir, f"result_shard{i}.json"))
+        off = _clock_offset(manifest, result, i)
+        offsets[str(i)] = off
+        for r in records:
+            r["ts"] = float(r.get("ts") or 0.0) + off
+        shard_streams[i] = records
+
+    every = coord_records + [r for recs in shard_streams.values()
+                             for r in recs]
+    t0 = min((float(r.get("ts") or 0.0) for r in every), default=0.0)
+    events: list = []
+    # (pid, tid, ts_us, dur_us) of every fleet.shard_run root span and
+    # every fleet.shard dispatch event, for the flow links
+    roots: dict = {}
+    dispatches: list = []
+
+    def add_stream(records, pid, label):
+        tids = []
+        for rec in records:
+            tid = int(rec.get("thread") or 0)
+            if tid not in tids:
+                tids.append(tid)
+            ts_us = (float(rec.get("ts") or 0.0) - t0) * 1e6
+            dur_us = max(float(rec.get("dur") or 0.0) * 1e6, 1.0)
+            name = rec.get("name", "?")
+            attrs = rec.get("attrs") or {}
+            events.append({
+                "name": name, "cat": name.split(".", 1)[0], "ph": "X",
+                "ts": ts_us, "dur": dur_us, "pid": pid, "tid": tid,
+                "args": {**attrs, "span_id": rec.get("id"),
+                         "fleet_run": rec.get("fleet_run"),
+                         "fleet_shard": rec.get("fleet_shard")},
+            })
+            if name == "fleet.shard_run":
+                # first root per pid wins (a re-run shard re-roots)
+                roots.setdefault(pid, (tid, ts_us, dur_us))
+            elif name == "fleet.shard" and pid == 1:
+                dispatches.append((attrs.get("shard"), tid, ts_us,
+                                   dur_us))
+        for i, tid in enumerate(tids):
+            events.append({"name": "thread_name", "ph": "M", "ts": 0,
+                           "pid": pid, "tid": tid,
+                           "args": {"name": f"thread-{tid}"}})
+            events.append({"name": "thread_sort_index", "ph": "M",
+                           "ts": 0, "pid": pid, "tid": tid,
+                           "args": {"sort_index": i}})
+        events.append({"name": "process_name", "ph": "M", "ts": 0,
+                       "pid": pid, "tid": 0, "args": {"name": label}})
+        events.append({"name": "process_sort_index", "ph": "M", "ts": 0,
+                       "pid": pid, "tid": 0,
+                       "args": {"sort_index": pid}})
+
+    add_stream(coord_records, 1, "fleet coordinator")
+    for i in sorted(shard_streams):
+        add_stream(shard_streams[i], 10 + i, f"shard {i}")
+
+    flow_links = 0
+    for shard, tid, ts_us, dur_us in dispatches:
+        try:
+            pid = 10 + int(shard)
+        except (TypeError, ValueError):
+            continue
+        root = roots.get(pid)
+        if root is None:
+            continue
+        rtid, rts, rdur = root
+        flow_links += 1
+        events.append({"name": "fleet.dispatch", "cat": "flow",
+                       "ph": "s", "id": flow_links,
+                       "ts": ts_us + min(0.5, dur_us / 2),
+                       "pid": 1, "tid": tid})
+        events.append({"name": "fleet.dispatch", "cat": "flow",
+                       "ph": "f", "bp": "e", "id": flow_links,
+                       "ts": rts + min(0.5, rdur / 2),
+                       "pid": pid, "tid": rtid})
+
+    trace = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "mplc_tpu fleet trace merge",
+                      "run_id": manifest.get("run_id"),
+                      "shards": len(shard_streams),
+                      "records": len(every),
+                      "clock_offsets_s": offsets,
+                      "flows": flow_links,
+                      "torn_lines": torn_total},
+    }
+    return {"trace": trace, "shard_tracks": len(shard_streams),
+            "flow_links": flow_links, "offsets": offsets,
+            "records": len(every), "torn_lines": torn_total}

@@ -244,6 +244,12 @@ def snapshot() -> dict:
                     "p50": _locked_quantile(m, 0.50),
                     "p95": _locked_quantile(m, 0.95),
                     "p99": _locked_quantile(m, 0.99),
+                    # raw log2 bucket counts (+Inf last): bounds are the
+                    # process-wide LOG_BUCKET_BOUNDS constant, so two
+                    # snapshots from different processes merge exactly
+                    # (merge_snapshots) — the fleet collector depends on
+                    # this field being present in every shard snapshot.
+                    "bucket_counts": list(m.bucket_counts),
                 }
         return out
 
@@ -252,15 +258,98 @@ def _locked_quantile(m: Histogram, q: float) -> float | None:
     """Histogram.quantile body for callers already holding `_lock`."""
     if not m.count:
         return None
-    rank = max(1, math.ceil(q * m.count))
+    return bucket_quantile(m.bucket_counts, m.count, m.min, m.max, q)
+
+
+def bucket_quantile(bucket_counts, count, mn, mx, q: float) -> float | None:
+    """Nearest-rank quantile over shared-log2-bucket counts: the upper
+    bound of the bucket holding the q-th ranked observation, clamped to
+    the observed [min, max]. Pure arithmetic on plain values so merged
+    (cross-process) histograms use the EXACT same estimator as live
+    Histogram objects — that identity is what makes fleet-merged
+    quantiles equal pooled-sample quantiles at bucket granularity."""
+    if not count:
+        return None
+    rank = max(1, math.ceil(q * count))
     cum = 0
-    for i, c in enumerate(m.bucket_counts):
+    for i, c in enumerate(bucket_counts):
         cum += c
         if cum >= rank:
             bound = (LOG_BUCKET_BOUNDS[i]
-                     if i < len(LOG_BUCKET_BOUNDS) else m.max)
-            return min(max(bound, m.min), m.max)
-    return m.max
+                     if i < len(LOG_BUCKET_BOUNDS) else mx)
+            return min(max(bound, mn), mx)
+    return mx
+
+
+def merge_snapshots(snaps) -> dict:
+    """Merge `snapshot()` dicts from multiple processes (fleet shards)
+    into one cluster-level snapshot. Semantics per kind:
+
+      counters    summed — fleet totals (device-seconds, batches, shed).
+      gauges      max of non-None values — every exported gauge is a
+                  high-water mark (device_mem_high_water_bytes), so the
+                  fleet value is the worst shard's.
+      histograms  exact merge: counts/sums/bucket_counts summed,
+                  min/max combined. Because every histogram shares
+                  LOG_BUCKET_BOUNDS, the merged buckets are identical to
+                  a histogram fed the pooled raw samples, so merged
+                  p50/p95/p99 EQUAL pooled-sample quantiles (not an
+                  approximation on top of an approximation).
+
+    Snapshots missing `bucket_counts` (pre-merge-era producers) degrade
+    gracefully: their counts/sums still aggregate, quantiles come from
+    whatever buckets are present. Non-dict entries are skipped."""
+    out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    merged_h: dict = {}
+    for snap in snaps or ():
+        if not isinstance(snap, dict):
+            continue
+        for k, v in (snap.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                out["counters"][k] = out["counters"].get(k, 0.0) + v
+        for k, v in (snap.get("gauges") or {}).items():
+            cur = out["gauges"].get(k)
+            if v is None:
+                out["gauges"].setdefault(k, None)
+            else:
+                out["gauges"][k] = v if cur is None else max(cur, v)
+        for k, h in (snap.get("histograms") or {}).items():
+            if not isinstance(h, dict) or not h.get("count"):
+                merged_h.setdefault(
+                    k, {"count": 0, "sum": 0.0, "min": math.inf,
+                        "max": -math.inf,
+                        "bucket_counts": [0] * (len(LOG_BUCKET_BOUNDS) + 1)})
+                continue
+            acc = merged_h.setdefault(
+                k, {"count": 0, "sum": 0.0, "min": math.inf,
+                    "max": -math.inf,
+                    "bucket_counts": [0] * (len(LOG_BUCKET_BOUNDS) + 1)})
+            acc["count"] += int(h.get("count") or 0)
+            acc["sum"] += float(h.get("sum") or 0.0)
+            if h.get("min") is not None:
+                acc["min"] = min(acc["min"], float(h["min"]))
+            if h.get("max") is not None:
+                acc["max"] = max(acc["max"], float(h["max"]))
+            bc = h.get("bucket_counts")
+            if isinstance(bc, (list, tuple)):
+                for i, c in enumerate(bc[:len(acc["bucket_counts"])]):
+                    acc["bucket_counts"][i] += int(c or 0)
+    for k, acc in merged_h.items():
+        n = acc["count"]
+        out["histograms"][k] = {
+            "count": n, "sum": acc["sum"],
+            "min": acc["min"] if n else None,
+            "max": acc["max"] if n else None,
+            "mean": acc["sum"] / n if n else None,
+            "p50": bucket_quantile(acc["bucket_counts"], n,
+                                   acc["min"], acc["max"], 0.50),
+            "p95": bucket_quantile(acc["bucket_counts"], n,
+                                   acc["min"], acc["max"], 0.95),
+            "p99": bucket_quantile(acc["bucket_counts"], n,
+                                   acc["min"], acc["max"], 0.99),
+            "bucket_counts": acc["bucket_counts"],
+        }
+    return out
 
 
 def export_view() -> list:

@@ -64,6 +64,14 @@ _collectors: list[list] = []
 
 TRACE_FILE_ENV = "MPLC_TPU_TRACE_FILE"
 FLIGHT_SIZE_ENV = "MPLC_TPU_FLIGHT_RECORDER_SIZE"
+# Fleet trace context (parallel/fleet.py injects both into worker env;
+# read per-record so an env overlay mid-process — the inproc fleet path —
+# stamps correctly): every span/event record emitted while these are set
+# carries `fleet_run` / `fleet_shard` fields, which is what lets
+# scripts/fleet_trace_merge.py correlate W per-shard JSONL streams into
+# one timeline by construction instead of by filename convention.
+FLEET_RUN_ID_ENV = "MPLC_TPU_FLEET_RUN_ID"
+FLEET_TRACE_SHARD_ENV = "MPLC_TPU_FLEET_SHARD_ID"
 
 
 # The span-name registry: EVERY literal name passed to span()/start_span()
@@ -132,6 +140,17 @@ SPAN_REGISTRY = {
                    "wallclock_s/coalitions)",
     "fleet.merge": "per-shard ledgers/memos merged into one sweep "
                    "(attrs: shards/coalitions/verified/wallclock_s)",
+    "fleet.shard_run": "root span of one fleet worker's shard execution "
+                       "(attrs: shard/shards/run) — the flow-link target "
+                       "of the coordinator's fleet.shard dispatch event "
+                       "in the merged Perfetto timeline",
+    "fleet.incident": "fleet incident bundle written on shard failure or "
+                      "merge refusal (attrs: run/reason/failed_shards/"
+                      "path)",
+    "fleet.collect": "one FleetCollector pass assembling the cluster "
+                     "snapshot (attrs: sources/shards/fresh)",
+    "fleet.scrape": "one shard scraped (HTTP /varz or published state) "
+                    "by the fleet collector (attrs: shard/source/ok)",
 }
 
 
@@ -200,6 +219,15 @@ def _sink_file():
 
 
 def _emit(record: dict) -> None:
+    # fleet trace context: stamped on every record while the coordinator's
+    # env injection is in effect, so cross-process correlation never
+    # depends on which file a record happened to land in
+    run = os.environ.get(FLEET_RUN_ID_ENV)
+    if run:
+        record["fleet_run"] = run
+        shard = os.environ.get(FLEET_TRACE_SHARD_ENV)
+        if shard:
+            record["fleet_shard"] = shard
     # the flight ring sees EVERY record, sink or not (deque.append is
     # atomic; maxlen bounds it) — the crash recorder must hold the spans
     # of a failure nobody was tracing on purpose

@@ -70,6 +70,11 @@ logger = __import__("logging").getLogger("mplc_tpu")
 FLEET_SHARDS_ENV = constants.FLEET_SHARDS_ENV
 FLEET_STATE_DIR_ENV = constants.FLEET_STATE_DIR_ENV
 FLEET_SHARD_ID_ENV = constants.FLEET_SHARD_ID_ENV
+# observability-plane knobs (sidecar-class): the coordinator injects the
+# first two into every worker env so trace records are correlatable and
+# clock-rebaseable; neither changes a single computed number
+FLEET_RUN_ID_ENV = constants.FLEET_RUN_ID_ENV
+FLEET_COORD_TS_ENV = constants.FLEET_COORD_TS_ENV
 
 
 class FleetError(RuntimeError):
@@ -249,6 +254,19 @@ def run_shard(spec: FleetSpec, shard: int, shards: int, out_dir: str,
     for key in ("done", "result", "ledger"):
         with contextlib.suppress(OSError):
             os.remove(paths[key])
+    # clock handshake: the coordinator stamped its own clock into the
+    # worker env at spawn; we echo it back beside our own clock readings
+    # (start here, end at result build) so fleet_trace_merge can rebase
+    # this shard's span stream onto the coordinator clock (midpoint rule)
+    worker_start_ts = time.time()
+    coord_ts = None
+    with contextlib.suppress(TypeError, ValueError):
+        raw = os.environ.get(FLEET_COORD_TS_ENV)
+        coord_ts = float(raw) if raw else None
+    run_id = os.environ.get(FLEET_RUN_ID_ENV)
+    from ..obs import trace as obs_trace
+    shard_span = obs_trace.start_span("fleet.shard_run", shard=shard,
+                                      shards=shards, run=run_id or "")
     t0 = time.perf_counter()
     env = {"MPLC_TPU_DETERMINISTIC_REDUCE": "1" if spec.deterministic
            else None,
@@ -313,6 +331,8 @@ def run_shard(spec: FleetSpec, shard: int, shards: int, out_dir: str,
     engine.save_cache(paths["cache"])
     after = _counters()
     wall = time.perf_counter() - t0
+    shard_span.end()   # root span: the flow-link target in the timeline
+    worker_end_ts = time.time()
     result = {
         "shard": shard,
         "shards": shards,
@@ -338,6 +358,23 @@ def run_shard(spec: FleetSpec, shard: int, shards: int, out_dir: str,
         "widths": sorted({w for (_p, _s), w in
                           (engine._fleet_widths or {}).items()})
         if engine._fleet_widths else [],
+        # fleet trace context + clock echo: the coordinator's spawn-time
+        # clock reading (coord_spawn_ts) echoed beside this worker's own
+        # start/end readings — with the coordinator's done-seen time
+        # (fleet_trace_manifest.json) these four timestamps give
+        # scripts/fleet_trace_merge.py a midpoint clock-offset estimate
+        # per shard, robust to cross-host skew
+        "fleet": {"run_id": run_id, "shard_id":
+                  os.environ.get(FLEET_SHARD_ID_ENV)},
+        "clock": {"coord_spawn_ts": coord_ts,
+                  "worker_start_ts": worker_start_ts,
+                  "worker_end_ts": worker_end_ts},
+        # this process's full metrics snapshot (shared log2 buckets):
+        # what the fleet collector's serverless path merges into the
+        # cluster rollup. Meaningful per-shard in subprocess fleets
+        # (fresh registry per worker); inproc shards share one registry,
+        # so their snapshots are cumulative, not disjoint.
+        "metrics": obs_metrics.snapshot(),
     }
     _atomic_json(paths["result"], result)
     # LAST act: the completion marker (crash before this line = no merge)
@@ -518,22 +555,68 @@ def run_fleet(spec: FleetSpec, shards: int, out_dir: str,
     otherwise."""
     from ..obs import trace as obs_trace
     os.makedirs(out_dir, exist_ok=True)
+    run_id = _mint_run_id()
+    manifest = {"run_id": run_id, "shards": shards,
+                "coordinator_pid": os.getpid(), "inproc": bool(inproc),
+                "spawn_ts": {}, "done_seen_ts": {}}
+    coord_records: list = []
     t0 = time.perf_counter()
+    try:
+        with obs_trace.collect() as coord_records, \
+                _env_overlay({FLEET_RUN_ID_ENV: run_id}):
+            result = _run_fleet_traced(
+                spec, shards, out_dir, inproc, devices_per_shard, env,
+                per_shard_env, ledger, timeout, concurrent,
+                verify_against, run_id, manifest, t0, obs_trace)
+    except FleetError as e:
+        # one postmortem artifact per failed run: trace/manifest first
+        # (the incident's trace tails read the shard files; a later
+        # manual fleet_trace_merge over the out_dir needs both)
+        _write_coordinator_trace(out_dir, coord_records)
+        _atomic_json(os.path.join(out_dir, "fleet_trace_manifest.json"),
+                     manifest)
+        _write_incident(out_dir, run_id, shards,
+                        reason=("merge_refused"
+                                if isinstance(e, FleetMergeError)
+                                else "shard_failure"),
+                        error=e,
+                        failed=getattr(e, "failed_shards", None))
+        raise
+    _write_coordinator_trace(out_dir, coord_records)
+    _atomic_json(os.path.join(out_dir, "fleet_trace_manifest.json"),
+                 manifest)
+    return result
+
+
+def _run_fleet_traced(spec, shards, out_dir, inproc, devices_per_shard,
+                      env, per_shard_env, ledger, timeout, concurrent,
+                      verify_against, run_id, manifest, t0,
+                      obs_trace) -> "FleetResult":
+    """run_fleet's traced body (split out so the wrapper can write the
+    coordinator trace + clock manifest and the incident bundle on BOTH
+    exit paths without a try/finally pyramid)."""
     with obs_trace.span("fleet.sweep", shards=shards,
                         inproc=bool(inproc),
-                        devices_per_shard=devices_per_shard):
+                        devices_per_shard=devices_per_shard, run=run_id):
         if inproc:
             for i in range(shards):
-                with _env_overlay((per_shard_env or {}).get(i) or {}):
+                spawn_ts = time.time()
+                manifest["spawn_ts"][str(i)] = spawn_ts
+                overlay = _shard_obs_env(out_dir, run_id, i, spawn_ts)
+                overlay.update((per_shard_env or {}).get(i) or {})
+                with _env_overlay(overlay):
                     rep = run_shard(spec, i, shards, out_dir,
                                     ledger=ledger)
-                obs_trace.event("fleet.shard", shard=i, shards=shards,
+                manifest["done_seen_ts"][str(i)] = time.time()
+                obs_trace.event("fleet.shard", dur=rep["wallclock_s"],
+                                shard=i, shards=shards,
                                 wallclock_s=rep["wallclock_s"],
                                 coalitions=len(rep["subsets"]))
         else:
             _run_fleet_subprocess(spec, shards, out_dir,
                                   devices_per_shard, env, per_shard_env,
-                                  ledger, timeout, concurrent)
+                                  ledger, timeout, concurrent,
+                                  run_id=run_id, manifest=manifest)
         values, merged, reports = merge_shard_results(spec, shards, out_dir)
         if merged is not None:
             _atomic_json(os.path.join(out_dir, "ledger_merged.json"),
@@ -680,7 +763,9 @@ def run_worker_subprocess(spec: FleetSpec, shard: int, shards: int,
 
 def _run_fleet_subprocess(spec, shards, out_dir, devices_per_shard, env,
                           per_shard_env, ledger, timeout,
-                          concurrent=True) -> None:
+                          concurrent=True, run_id=None,
+                          manifest=None) -> None:
+    from ..obs import trace as obs_trace
     spec_path = os.path.join(out_dir, "fleet_spec.json")
     with open(spec_path, "w") as f:
         f.write(spec.to_json())
@@ -688,17 +773,24 @@ def _run_fleet_subprocess(spec, shards, out_dir, devices_per_shard, env,
     deadline = time.monotonic() + timeout
 
     def _spawn(i):
-        wenv = worker_env(env, devices_per_shard,
-                          (per_shard_env or {}).get(i))
+        spawn_ts = time.time()
+        if manifest is not None:
+            manifest["spawn_ts"][str(i)] = spawn_ts
+        # observability env first, caller's per-shard knobs LAST — an
+        # explicit per-shard override (a test pointing the trace file
+        # elsewhere) must beat the coordinator's defaults
+        extra = _shard_obs_env(out_dir, run_id, i, spawn_ts)
+        extra.update((per_shard_env or {}).get(i) or {})
+        wenv = worker_env(env, devices_per_shard, extra)
         wenv.setdefault("PYTHONPATH", repo_root)
         log_path = os.path.join(out_dir, f"worker_shard{i}.log")
         log = open(log_path, "w")
-        return (i, subprocess.Popen(
+        return (i, spawn_ts, subprocess.Popen(
             worker_argv(spec_path, i, shards, out_dir, ledger),
             env=wenv, stdout=log, stderr=subprocess.STDOUT,
             cwd=repo_root), log, log_path)
 
-    def _wait(i, p, log, log_path):
+    def _wait(i, spawn_ts, p, log, log_path):
         left = max(1.0, deadline - time.monotonic())
         try:
             rc = p.wait(left)
@@ -706,6 +798,15 @@ def _run_fleet_subprocess(spec, shards, out_dir, devices_per_shard, env,
             p.kill()
             rc = -9
         log.close()
+        done_ts = time.time()
+        if manifest is not None:
+            manifest["done_seen_ts"][str(i)] = done_ts
+        # dispatch anchor for the merged timeline: backdated to spawn
+        # time, so the flow arrow to the shard's root span starts where
+        # the coordinator actually handed the work off
+        obs_trace.event("fleet.shard", dur=done_ts - spawn_ts, shard=i,
+                        shards=shards, wallclock_s=done_ts - spawn_ts,
+                        rc=rc)
         if rc == 0:
             return None
         tail = ""
@@ -726,30 +827,191 @@ def _run_fleet_subprocess(spec, shards, out_dir, devices_per_shard, env,
     if failed:
         detail = "; ".join(f"shard {i} rc={rc}: ...{tail[-400:]}"
                            for i, rc, tail in failed)
-        raise FleetError(f"{len(failed)} fleet worker(s) failed: {detail}")
+        err = FleetError(
+            f"{len(failed)} fleet worker(s) failed: {detail}")
+        err.failed_shards = [i for i, _rc, _tail in failed]
+        raise err
+
+
+def _mint_run_id() -> str:
+    """A collision-resistant fleet run id (hex, no wall-clock coupling):
+    the correlation key stamped into every coordinator AND worker trace
+    record for one run_fleet call."""
+    import secrets
+    return f"fleet-{secrets.token_hex(6)}"
+
+
+def _shard_obs_env(out_dir: str, run_id: str, shard: int,
+                   spawn_ts: float) -> dict:
+    """The observability overlay injected beside the ledger/reduce env:
+    trace context (run id + shard id, stamped on every record by
+    obs/trace._emit), the coordinator's spawn-time clock reading (echoed
+    back in the result JSON for the clock-offset handshake), a per-shard
+    trace file and a per-shard flight-recorder dir — both inside the
+    fleet out_dir, where the merge script and the incident bundler
+    expect them. Chrome conversion is left to the coordinator: one
+    merged timeline, not W partial ones."""
+    return {
+        FLEET_RUN_ID_ENV: run_id,
+        FLEET_SHARD_ID_ENV: f"shard{shard}",
+        FLEET_COORD_TS_ENV: repr(spawn_ts),
+        "MPLC_TPU_TRACE_FILE":
+            os.path.join(out_dir, f"trace_shard{shard}.jsonl"),
+        "MPLC_TPU_FLIGHT_RECORDER_DIR":
+            os.path.join(out_dir, f"flight_shard{shard}"),
+        "MPLC_TPU_CHROME_TRACE_FILE": None,
+    }
+
+
+def _write_coordinator_trace(out_dir: str, records: list) -> None:
+    """Persist the coordinator's own span stream (fleet.sweep,
+    fleet.shard dispatch events, fleet.merge) as trace_coordinator.jsonl.
+    Records stamped with a `fleet_shard` are dropped: on the inproc path
+    the collector saw the shards' records too, and those already live in
+    the per-shard trace files — the merge script must not see them
+    twice."""
+    try:
+        path = os.path.join(out_dir, "trace_coordinator.jsonl")
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            for r in records:
+                if "fleet_shard" not in r:
+                    f.write(json.dumps(r) + "\n")
+        os.replace(tmp, path)
+    except (OSError, TypeError, ValueError) as e:
+        logger.warning("fleet: coordinator trace write failed: %s", e)
+
+
+def _tail_lines(path: str, n: int = 200) -> list:
+    try:
+        with open(path) as f:
+            return f.readlines()[-n:]
+    except OSError:
+        return []
+
+
+def _ledger_digest(path: str) -> "dict | None":
+    """A small content digest of one shard's value-provenance ledger —
+    enough for a postmortem to pin WHICH game/values the shard claimed
+    without shipping the whole ledger into the bundle."""
+    try:
+        import hashlib
+        with open(path, "rb") as f:
+            body = f.read()
+        doc = json.loads(body)
+        return {"path": path, "sha256": hashlib.sha256(body).hexdigest(),
+                "entries": len(doc.get("entries") or {}),
+                "engine_fingerprint": doc.get("engine_fingerprint"),
+                "reduction_mode": (doc.get("meta") or {}).get(
+                    "reduction_mode")}
+    except (OSError, ValueError):
+        return None
+
+
+def _write_incident(out_dir: str, run_id: str, shards: int, reason: str,
+                    error: BaseException,
+                    failed: "list | None") -> "str | None":
+    """Gather ONE timestamped postmortem dir for a failed fleet run:
+    per failed shard its flight-recorder dumps, trace tail, worker-log
+    tail and ledger digest, plus the cluster snapshot — instead of W
+    scattered artifacts an operator has to correlate by hand at 3am.
+    Never raises; returns the incident dir (or None)."""
+    try:
+        from ..obs import metrics as obs_metrics
+        from ..obs import trace as obs_trace
+        if not failed:
+            # merge refusals don't always name a shard: blame the shards
+            # without completion markers, else keep every shard's story
+            failed = [i for i in range(shards) if not os.path.exists(
+                _shard_paths(out_dir, i)["done"])] or list(range(shards))
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        inc_dir = os.path.join(
+            out_dir, f"incident_{stamp}_{run_id.split('-')[-1]}")
+        os.makedirs(inc_dir, exist_ok=True)
+        bundle = {"run_id": run_id, "reason": reason,
+                  "error": str(error)[:4000], "ts": time.time(),
+                  "shards": shards, "failed_shards": sorted(failed),
+                  "shard_artifacts": {}}
+        import shutil
+        for i in sorted(failed):
+            art: dict = {}
+            fdir = os.path.join(out_dir, f"flight_shard{i}")
+            dumps = []
+            if os.path.isdir(fdir):
+                for name in sorted(os.listdir(fdir)):
+                    if name.startswith("mplc_flight_"):
+                        with contextlib.suppress(OSError):
+                            shutil.copy2(os.path.join(fdir, name),
+                                         os.path.join(inc_dir, name))
+                            dumps.append(name)
+            art["flight_dumps"] = dumps
+            tail = _tail_lines(
+                os.path.join(out_dir, f"trace_shard{i}.jsonl"))
+            if tail:
+                tail_name = f"trace_tail_shard{i}.jsonl"
+                with open(os.path.join(inc_dir, tail_name), "w") as f:
+                    f.writelines(tail)
+                art["trace_tail"] = tail_name
+                art["trace_tail_records"] = len(tail)
+            log_tail = _tail_lines(
+                os.path.join(out_dir, f"worker_shard{i}.log"), 40)
+            if log_tail:
+                art["log_tail"] = "".join(log_tail)[-2000:]
+            art["ledger_digest"] = _ledger_digest(
+                _shard_paths(out_dir, i)["ledger"])
+            bundle["shard_artifacts"][str(i)] = art
+        from ..obs import fleet_view
+        bundle["cluster"] = fleet_view.cluster_snapshot(
+            out_dir=out_dir,
+            state_dir=os.environ.get(FLEET_STATE_DIR_ENV))
+        _atomic_json(os.path.join(inc_dir, "incident.json"), bundle)
+        obs_metrics.counter("fleet.incidents").inc()
+        obs_trace.event("fleet.incident", run=run_id, reason=reason,
+                        failed_shards=len(failed), path=inc_dir)
+        logger.warning("fleet: incident bundle written to %s", inc_dir)
+        return inc_dir
+    except Exception as e:  # noqa: BLE001 — postmortems must not mask
+        logger.error("fleet: incident bundle failed: %s", e)
+        return None
 
 
 # ---------------------------------------------------------------------------
 # cross-shard service state (the admission governor's fleet view)
 # ---------------------------------------------------------------------------
 
+_publish_warned = False
+
+
 def publish_shard_state(state_dir: str, shard_id: str,
                         payload: dict) -> None:
     """Atomically publish one service shard's queue/admission snapshot
     into the shared fleet state dir. Never raises — a full disk must not
-    take down the service whose state it merely mirrors."""
+    take down the service whose state it merely mirrors — but failures
+    are COUNTED (`fleet.state_publish_errors`, surfaced in /varz) and
+    warned once per process, mirroring sample_device_memory: a fleet
+    whose state publishing silently stopped looks exactly like a healthy
+    shard that went quiet, and the cluster view would flag it stale with
+    nobody knowing why."""
+    global _publish_warned
     try:
         os.makedirs(state_dir, exist_ok=True)
         safe = re.sub(r"[^A-Za-z0-9_.-]", "_", str(shard_id))
         _atomic_json(os.path.join(state_dir, f"shard_{safe}.json"),
                      {**payload, "shard": str(shard_id),
                       "ts": time.time()})
-    except OSError as e:
-        logger.warning("fleet: shard-state publish to %r failed: %s",
-                       state_dir, e)
+    except Exception as e:  # noqa: BLE001 — mirror, never a crash
+        from ..obs import metrics as obs_metrics
+        obs_metrics.counter("fleet.state_publish_errors").inc()
+        if not _publish_warned:
+            _publish_warned = True
+            logger.warning(
+                "fleet: shard-state publish to %r failed (%s); further "
+                "failures are counted in fleet.state_publish_errors "
+                "without logging", state_dir, e)
 
 
-def cluster_view(state_dir: str, stale_sec: float = 30.0) -> dict:
+def cluster_view(state_dir: str, stale_sec: float = 30.0,
+                 include_metrics: bool = False) -> dict:
     """Aggregate every shard's published state: per-shard rows (stale
     ones flagged, not dropped — a wedged shard's last word is evidence)
     plus cluster totals the admission governor and /healthz expose.
@@ -772,9 +1034,18 @@ def cluster_view(state_dir: str, stale_sec: float = 30.0) -> dict:
                 doc = json.load(f)
         except (OSError, ValueError):
             continue
-        age = now - float(doc.get("ts") or 0)
+        # clamp: a publisher whose clock runs AHEAD of ours (cross-host
+        # skew) must read as freshly published (age 0, live), not as a
+        # negative age that could flap stale under a naive abs() rule
+        age = max(0.0, now - float(doc.get("ts") or 0))
         doc["age_sec"] = age
         doc["stale"] = age > stale_sec
+        if not include_metrics:
+            # the embedded per-shard metrics snapshot (the collector's
+            # serverless source) stays OUT of the default view: the
+            # /healthz fleet block is unauthenticated and tenant-labeled
+            # series must never ride it
+            doc.pop("metrics", None)
         shards[str(doc.get("shard") or name)] = doc
     live = {k: d for k, d in shards.items()
             if not d["stale"] and not d.get("closed")}
@@ -808,8 +1079,19 @@ def _cli_worker(args) -> int:
         jax.config.update("jax_platforms", platform.split(",")[0])
     with open(args.spec) as f:
         spec = FleetSpec.from_json(f.read())
-    rep = run_shard(spec, shard, shards, args.out,
-                    ledger=not args.no_ledger)
+    try:
+        rep = run_shard(spec, shard, shards, args.out,
+                        ledger=not args.no_ledger)
+    except BaseException as e:  # noqa: BLE001 — incl. InjectedCrash
+        # last act of a dying worker: a flight-recorder postmortem into
+        # the per-shard flight dir the coordinator injected, so the
+        # fleet incident bundle always has this shard's final records
+        # even when the failure was a simulated hard kill
+        from ..obs import flight
+        flight.dump("fleet_worker_crash",
+                    extra={"shard": shard, "shards": shards,
+                           "error": repr(e)[:500]})
+        raise
     print(json.dumps({"shard": shard, "coalitions": len(rep["subsets"]),
                       "wallclock_s": rep["wallclock_s"]}))
     return 0
@@ -836,12 +1118,34 @@ def _cli_selfcheck(args) -> int:
         ok = (diff["comparable"] and not diff["drift"]
               and diff["kendall_tau"] == 1.0
               and diff["common"] == len(spec.all_subsets()))
+        obs = None
+        if args.obs_dir:
+            # CI fleet-smoke artifacts: ONE merged Perfetto timeline and
+            # ONE aggregated /fleet/varz-shaped snapshot from the real
+            # W-shard subprocess run, both asserted to carry one entry
+            # per shard before the selfcheck claims success
+            from ..obs import fleet_view
+            os.makedirs(args.obs_dir, exist_ok=True)
+            merged = fleet_view.merge_fleet_traces(got.out_dir)
+            trace_path = os.path.join(args.obs_dir, "fleet_trace.json")
+            _atomic_json(trace_path, merged["trace"])
+            snap = fleet_view.cluster_snapshot(out_dir=got.out_dir)
+            varz_path = os.path.join(args.obs_dir, "fleet_varz.json")
+            _atomic_json(varz_path, snap)
+            obs = {"trace": trace_path, "varz": varz_path,
+                   "shard_tracks": merged["shard_tracks"],
+                   "flow_links": merged["flow_links"],
+                   "snapshot_shards": len(snap.get("shards") or {})}
+            ok = (ok and merged["shard_tracks"] == args.shards
+                  and merged["flow_links"] == args.shards
+                  and obs["snapshot_shards"] == args.shards)
         print(json.dumps({
             "shards": args.shards, "subsets": diff["common"],
             "comparable": diff["comparable"], "drift": diff["drift"],
             "max_ulp": diff["ulp"]["max"],
             "kendall_tau": diff["kendall_tau"],
             "wallclock_s": round(time.perf_counter() - t0, 1),
+            "obs": obs,
             "ok": ok}))
         if not ok:
             print(f"[fleet] selfcheck FAILED: {args.shards}-shard merged "
@@ -864,6 +1168,11 @@ def main(argv=None) -> int:
                          "non-zero on any drift")
     ap.add_argument("--shards", type=int, default=2)
     ap.add_argument("--timeout", type=float, default=1200.0)
+    ap.add_argument("--obs-dir", default=None,
+                    help="selfcheck: also write the merged Perfetto "
+                         "trace + aggregated fleet varz snapshot here "
+                         "and fail unless both carry one entry per "
+                         "shard")
     args = ap.parse_args(argv)
     if args.spec:
         if not (args.shard and args.out):

@@ -1222,6 +1222,13 @@ class SweepService:
                 "admission_state": self._admission.state,
                 "closed": self._closed,
             }
+        # the full metrics snapshot rides along (shared log2 buckets):
+        # this is what makes the published state dir a SERVERLESS fleet
+        # metrics source — obs/fleet_view.FleetCollector merges these
+        # per-shard snapshots into cluster-true per-tenant SLO quantiles
+        # without any shard exposing an HTTP port
+        from ..obs import metrics as obs_metrics
+        payload["metrics"] = obs_metrics.snapshot()
         from ..parallel import fleet
         fleet.publish_shard_state(self._fleet_dir, self._fleet_shard,
                                   payload)

@@ -64,6 +64,13 @@ _ROWS = {
     "report.device_time.device_s": "lower",
     "report.resilience.retries": "lower",
     "report.resilience.cap_halvings": "lower",
+    # fleet-health rows (config 9 sidecar, `fleet` block at top level —
+    # that sidecar has no `report` wrapper so these paths are absolute):
+    # shard balance (max/median sweep, 1.0 = perfectly balanced) and
+    # shard-count-normalized throughput — a fleet can hold its critical
+    # path while quietly growing a straggler; these rows catch that
+    "fleet.straggler_ratio": "lower",
+    "fleet.coalitions_per_shard_s": "higher",
 }
 
 

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Merge a fleet run's per-shard span traces into ONE Perfetto timeline.
+
+A W-shard fleet run (python -m mplc_tpu.parallel.fleet, or any
+`run_fleet` caller) leaves in its out_dir:
+
+    trace_coordinator.jsonl      the coordinator's span stream
+    trace_shardI.jsonl           each worker's span stream (W files)
+    result_shardI.json           worker results incl. the clock echo
+    fleet_trace_manifest.json    coordinator spawn/done-seen timestamps
+
+This script rebases every shard stream onto the coordinator clock
+(midpoint rule over the 4-timestamp handshake — see
+obs/fleet_view._clock_offset) and emits one Chrome trace-event JSON:
+one track group (process) per shard, flow arrows from each
+`fleet.shard` dispatch event to that shard's `fleet.shard_run` root
+span. Load the output at https://ui.perfetto.dev.
+
+Usage:
+    python scripts/fleet_trace_merge.py OUT_DIR [-o fleet_trace.json]
+
+Exits non-zero when the out_dir holds no shard streams.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mplc_tpu.obs import fleet_view  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-shard fleet traces into one Perfetto "
+                    "timeline")
+    ap.add_argument("out_dir", help="fleet run output dir (holds "
+                                    "trace_shardI.jsonl et al.)")
+    ap.add_argument("-o", "--output", default=None,
+                    help="merged trace path (default: "
+                         "OUT_DIR/fleet_trace.json)")
+    args = ap.parse_args(argv)
+    merged = fleet_view.merge_fleet_traces(args.out_dir)
+    if merged["shard_tracks"] == 0:
+        print(f"[fleet-trace] no trace_shardI.jsonl streams found in "
+              f"{args.out_dir}", file=sys.stderr)
+        return 1
+    out_path = args.output or os.path.join(args.out_dir,
+                                           "fleet_trace.json")
+    tmp = f"{out_path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged["trace"], f)
+    os.replace(tmp, out_path)
+    print(json.dumps({
+        "out": out_path,
+        "shard_tracks": merged["shard_tracks"],
+        "flow_links": merged["flow_links"],
+        "records": merged["records"],
+        "clock_offsets_s": {k: round(v, 6)
+                            for k, v in merged["offsets"].items()},
+        "torn_lines": merged["torn_lines"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

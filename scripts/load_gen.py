@@ -126,6 +126,32 @@ def _scrape_metrics() -> "dict | None":
     return out
 
 
+def _scrape_fleet() -> "dict | None":
+    """Cluster-level view for `--fleet`: collect the aggregated fleet
+    snapshot from whatever sources the environment names — HTTP peers
+    (`MPLC_TPU_FLEET_PEERS`), a shared fleet state dir
+    (`MPLC_TPU_FLEET_STATE_DIR`), or a fleet out_dir of result files —
+    so a load run against one shard of a fleet still reports the
+    CLUSTER-true SLO quantiles (merged histograms, exact at log2-bucket
+    granularity), not just its own shard's."""
+    from mplc_tpu.obs import fleet_view
+    coll = fleet_view.collector_from_env()
+    if coll is None:
+        return {"error": "no fleet sources configured (set "
+                         "MPLC_TPU_FLEET_PEERS or "
+                         "MPLC_TPU_FLEET_STATE_DIR)"}
+    try:
+        snap = coll.collect()
+    except Exception as e:
+        return {"error": str(e)[:200]}
+    return {"shard_count": snap.get("shard_count"),
+            "fresh_shards": snap.get("fresh_shards"),
+            "merged_sources": snap.get("merged_sources"),
+            "slo": snap.get("slo"),
+            "device_seconds_total": snap.get("device_seconds_total"),
+            "shards": snap.get("shards")}
+
+
 def solo_reference(builder) -> dict:
     """Fault-free solo-engine v(S) table for one game — the bit-identity
     oracle. Runs OUTSIDE the service on a private engine, exactly the
@@ -364,6 +390,10 @@ def main(argv=None) -> int:
     ap.add_argument("--shed-p99-sec", type=float, default=None)
     ap.add_argument("--epochs", type=int, default=1)
     ap.add_argument("--timeout-sec", type=float, default=24 * 3600)
+    ap.add_argument("--fleet", action="store_true",
+                    help="attach the aggregated fleet snapshot (cluster-"
+                         "true SLO quantiles) from MPLC_TPU_FLEET_PEERS / "
+                         "MPLC_TPU_FLEET_STATE_DIR to the report")
     ap.add_argument("--out", default=None,
                     help="write the JSON report here (default stdout)")
     args = ap.parse_args(argv)
@@ -375,6 +405,8 @@ def main(argv=None) -> int:
                       slice_coalitions=args.slice,
                       shed_p99_sec=args.shed_p99_sec, epochs=args.epochs,
                       timeout_sec=args.timeout_sec)
+    if args.fleet:
+        report["fleet"] = _scrape_fleet()
     text = json.dumps(report, indent=2, default=str)
     if args.out:
         with open(args.out, "w") as f:

@@ -233,12 +233,58 @@ def test_fleet_subprocess_workers_cross_topology_equality(ref_fleet,
                           timeout=600, verify_against=ref_fleet.ledger)
     assert not res.diff["drift"] and res.diff["kendall_tau"] == 1.0
     assert res.values == ref_fleet.values
+    reps = {}
     for i in range(2):
         assert (out / f".shard{i}.done").exists()
         rep = json.loads((out / f"result_shard{i}.json").read_text())
         assert rep["devices"] == 1
         assert rep["deterministic"] is True
+        reps[i] = rep
     assert (out / "ledger_merged.json").exists()
+
+    # -- the fleet observability plane, over the same real-subprocess run --
+    from mplc_tpu.obs import fleet_view
+    from mplc_tpu.obs import metrics as obs_metrics
+    # trace context: both workers echoed the coordinator's run id + their
+    # shard identity and clock readings in the handshake
+    run_ids = {reps[i]["fleet"]["run_id"] for i in (0, 1)}
+    assert len(run_ids) == 1 and run_ids.pop().startswith("fleet-")
+    assert {reps[i]["fleet"]["shard_id"] for i in (0, 1)} \
+        == {"shard0", "shard1"}
+    for i in (0, 1):
+        clk = reps[i]["clock"]
+        assert clk["coord_spawn_ts"] is not None
+        assert clk["worker_end_ts"] >= clk["worker_start_ts"]
+    # ONE merged Perfetto timeline: a track group per shard, a flow link
+    # per dispatch, every shard rebased onto the coordinator clock
+    merged = fleet_view.merge_fleet_traces(str(out))
+    assert merged["shard_tracks"] == 2 and merged["flow_links"] == 2
+    assert set(merged["offsets"]) == {"0", "1"}
+    # same-host subprocesses share a clock: the midpoint offsets must be
+    # tiny (sanity for the rebase arithmetic, not a skew measurement)
+    assert all(abs(off) < 60.0 for off in merged["offsets"].values())
+    # ONE aggregated snapshot: one entry per shard, and the merged
+    # histograms are EXACTLY the pooled per-shard samples — merged
+    # bucket arrays are elementwise sums and the quantiles re-derive
+    # from them with the same estimator
+    snap = fleet_view.cluster_snapshot(out_dir=str(out))
+    assert set(snap["shards"]) == {"shard0", "shard1"}
+    assert snap["fresh_shards"] == 2 and snap["merged_sources"] == 2
+    per_shard = [reps[i]["metrics"]["histograms"] for i in (0, 1)]
+    checked = 0
+    for key, mh in snap["merged"]["histograms"].items():
+        pooled = [0] * len(mh["bucket_counts"])
+        for hs in per_shard:
+            for j, c in enumerate((hs.get(key) or {})
+                                  .get("bucket_counts") or []):
+                pooled[j] += c
+        assert mh["bucket_counts"] == pooled, key
+        if mh["count"]:
+            checked += 1
+            for q, want in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+                assert mh[q] == obs_metrics.bucket_quantile(
+                    pooled, mh["count"], mh["min"], mh["max"], want), key
+    assert checked > 0  # real histograms flowed through the merge
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,348 @@
+"""Fleet observability plane (obs/fleet_view.py + the fleet.py wiring).
+
+The plane's three contracts, each tested against the mechanism rather
+than the happy path:
+
+  - MERGED METRICS ARE EXACT: because every histogram shares
+    metrics.LOG_BUCKET_BOUNDS, `merge_snapshots` over W per-shard
+    snapshots must report the SAME quantiles as one histogram fed the
+    pooled raw samples — not an average of per-shard quantiles.
+  - ONE TIMELINE PER RUN: a fleet run leaves per-shard trace streams
+    plus a coordinator stream and a clock manifest; merge_fleet_traces
+    rebases every shard onto the coordinator clock (midpoint rule) and
+    links each dispatch to its shard's root span with a flow arrow.
+  - ONE INCIDENT PER FAILED RUN: a shard killed mid-sweep yields
+    exactly one timestamped bundle with the flight dump, trace tail,
+    ledger digest and cluster snapshot — never W scattered artifacts,
+    never an exception that masks the original failure.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from mplc_tpu.obs import fleet_view
+from mplc_tpu.obs import metrics as obs_metrics
+from mplc_tpu.obs import trace as obs_trace
+from mplc_tpu.parallel import fleet
+
+
+# ---------------------------------------------------------------------------
+# merge_snapshots: exactness
+# ---------------------------------------------------------------------------
+
+def _hist_snapshot_entry(h):
+    """snapshot()-shaped dict for one bare Histogram object."""
+    return {"count": h.count, "sum": h.total,
+            "min": h.min if h.count else None,
+            "max": h.max if h.count else None,
+            "p50": h.quantile(0.50), "p95": h.quantile(0.95),
+            "p99": h.quantile(0.99),
+            "bucket_counts": list(h.bucket_counts)}
+
+
+def test_merged_quantiles_equal_pooled_sample_quantiles():
+    """The exactness claim, tested sample-for-sample: W per-shard
+    histograms merged via merge_snapshots must report IDENTICAL
+    p50/p95/p99 (and count/sum/min/max/bucket_counts) to one histogram
+    that observed the pooled raw samples — for every quantile, because
+    the shared log2 buckets make the merge lossless."""
+    rng = random.Random(7)
+    key = "service.queue_wait_sec{tenant=t0}"
+    pooled = obs_metrics.Histogram("service.queue_wait_sec",
+                                   {"tenant": "t0"})
+    snaps = []
+    for _shard in range(4):
+        h = obs_metrics.Histogram("service.queue_wait_sec",
+                                  {"tenant": "t0"})
+        for _ in range(rng.randrange(5, 120)):
+            v = rng.lognormvariate(-2.0, 3.0)  # spans many log2 buckets
+            h.observe(v)
+            pooled.observe(v)
+        snaps.append({"histograms": {key: _hist_snapshot_entry(h)}})
+    merged = obs_metrics.merge_snapshots(snaps)["histograms"][key]
+    want = _hist_snapshot_entry(pooled)
+    assert merged["count"] == want["count"]
+    assert merged["sum"] == pytest.approx(want["sum"])
+    assert merged["min"] == want["min"] and merged["max"] == want["max"]
+    assert merged["bucket_counts"] == want["bucket_counts"]
+    for q in ("p50", "p95", "p99"):
+        assert merged[q] == want[q], (q, merged[q], want[q])
+    # and not just the three shortcuts: every quantile agrees, because
+    # the estimator runs over identical bucket arrays
+    for q in (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0):
+        got = obs_metrics.bucket_quantile(
+            merged["bucket_counts"], merged["count"], merged["min"],
+            merged["max"], q)
+        assert got == pooled.quantile(q), q
+
+
+def test_merge_snapshots_counter_gauge_semantics():
+    a = {"counters": {"engine.batches": 3, "fleet.incidents": 1},
+         "gauges": {"engine.device_mem_high_water_bytes": 100}}
+    b = {"counters": {"engine.batches": 4},
+         "gauges": {"engine.device_mem_high_water_bytes": 900,
+                    "unset": None}}
+    out = obs_metrics.merge_snapshots([a, b, None, "junk"])
+    assert out["counters"]["engine.batches"] == 7
+    assert out["counters"]["fleet.incidents"] == 1
+    # gauges are high-water marks: the fleet value is the worst shard's
+    assert out["gauges"]["engine.device_mem_high_water_bytes"] == 900
+    assert out["gauges"]["unset"] is None
+    # an empty-count histogram entry still yields an empty merged entry
+    out2 = obs_metrics.merge_snapshots(
+        [{"histograms": {"h": {"count": 0}}}])
+    assert out2["histograms"]["h"]["count"] == 0
+    assert out2["histograms"]["h"]["p99"] is None
+
+
+# ---------------------------------------------------------------------------
+# trace context propagation + clock rebase
+# ---------------------------------------------------------------------------
+
+def test_trace_records_stamped_with_fleet_context():
+    """While the coordinator's env injection is in effect, EVERY emitted
+    record carries fleet_run/fleet_shard — the correlation fields the
+    merge keys on; outside the overlay nothing is stamped."""
+    with fleet._env_overlay({obs_trace.FLEET_RUN_ID_ENV: "fleet-abc123",
+                             obs_trace.FLEET_TRACE_SHARD_ENV: "shard3"}):
+        with obs_trace.collect() as recs:
+            obs_trace.event("fleet.scrape", shard="s", source="t", ok=True)
+    assert recs[0]["fleet_run"] == "fleet-abc123"
+    assert recs[0]["fleet_shard"] == "shard3"
+    with fleet._env_overlay({obs_trace.FLEET_RUN_ID_ENV: None,
+                             obs_trace.FLEET_TRACE_SHARD_ENV: None}):
+        with obs_trace.collect() as recs2:
+            obs_trace.event("fleet.scrape", shard="s", source="t", ok=True)
+    assert "fleet_run" not in recs2[0]
+    assert "fleet_shard" not in recs2[0]
+
+
+def test_clock_offset_midpoint_rule():
+    """offset = ((spawn - start) + (done - end)) / 2: symmetric
+    spawn/teardown latency cancels, a pure clock skew survives intact;
+    missing done-seen degrades one-sided, no handshake at all -> 0."""
+    manifest = {"spawn_ts": {"0": 100.0}, "done_seen_ts": {"0": 110.0}}
+    # worker clock runs 5 s BEHIND: start/end read 5 less than truth,
+    # with 1 s spawn latency and 1 s teardown latency on each side
+    result = {"clock": {"worker_start_ts": 96.0, "worker_end_ts": 104.0}}
+    off = fleet_view._clock_offset(manifest, result, 0)
+    assert off == pytest.approx(5.0)
+    # one-sided fallback (crashed shard: no done-seen record)
+    off1 = fleet_view._clock_offset({"spawn_ts": {"0": 100.0}},
+                                    result, 0)
+    assert off1 == pytest.approx(100.0 - 96.0)
+    # no handshake at all
+    assert fleet_view._clock_offset({}, None, 0) == 0.0
+
+
+def test_merge_fleet_traces_inproc_run(tmp_path):
+    """A real (tiny, inproc) 2-shard fleet run merges into ONE Perfetto
+    document: one track group per shard, one flow link per dispatch,
+    coordinator records deduped from the shard streams, and every
+    shard's offset present in the manifest-driven rebase."""
+    out = str(tmp_path / "run")
+    res = fleet.run_fleet(fleet.FleetSpec(), 2, out, inproc=True)
+    assert len(res.values) == 7
+    merged = fleet_view.merge_fleet_traces(out)
+    assert merged["shard_tracks"] == 2
+    assert merged["flow_links"] == 2
+    assert merged["torn_lines"] == 0
+    assert set(merged["offsets"]) == {"0", "1"}
+    ev = merged["trace"]["traceEvents"]
+    # the coordinator's stream must not re-contain shard records (the
+    # inproc collector saw them; dedupe is by the fleet_shard stamp)
+    coord_named = [e for e in ev if e.get("pid") == 1 and e["ph"] == "X"]
+    assert all(not (e["args"] or {}).get("fleet_shard")
+               for e in coord_named)
+    # one process_name metadata row per track group
+    names = {e["args"]["name"] for e in ev
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {"fleet coordinator", "shard 0", "shard 1"}
+    # flow arrows pair s/f records under one id, landing on shard pids
+    flows = [e for e in ev if e.get("cat") == "flow"]
+    assert sorted(e["ph"] for e in flows) == ["f", "f", "s", "s"]
+    assert {e["pid"] for e in flows if e["ph"] == "f"} == {10, 11}
+    # the run id is stamped through to the merged doc
+    run_id = merged["trace"]["otherData"]["run_id"]
+    assert run_id and run_id.startswith("fleet-")
+    shard_recs = [e for e in ev if e["ph"] == "X"
+                  and (e["args"] or {}).get("fleet_shard")]
+    assert shard_recs
+    assert all(e["args"]["fleet_run"] == run_id for e in shard_recs)
+
+    # the aggregated snapshot over the same out_dir sees both shards
+    snap = fleet_view.cluster_snapshot(out_dir=out)
+    assert set(snap["shards"]) == {"shard0", "shard1"}
+    assert snap["fresh_shards"] == 2 and snap["merged_sources"] == 2
+
+
+# ---------------------------------------------------------------------------
+# collector sources + /fleet rendering
+# ---------------------------------------------------------------------------
+
+def test_collector_state_dir_source_merges_published_metrics(tmp_path):
+    d = str(tmp_path / "state")
+    snapA = {"counters": {"service.device_seconds{tenant=t0}": 2.0}}
+    snapB = {"counters": {"service.device_seconds{tenant=t0}": 3.0,
+                          "service.device_seconds{tenant=t1}": 1.0}}
+    fleet.publish_shard_state(d, "alpha", {"queue_depth": 1,
+                                           "metrics": snapA})
+    fleet.publish_shard_state(d, "beta", {"queue_depth": 2,
+                                          "metrics": snapB})
+    out = fleet_view.FleetCollector(state_dir=d).collect()
+    assert out["shard_count"] == 2 and out["fresh_shards"] == 2
+    assert out["merged_sources"] == 2
+    assert out["device_seconds_total"] == pytest.approx(6.0)
+    assert out["tenant_device_seconds"] == {"t0": pytest.approx(5.0),
+                                            "t1": pytest.approx(1.0)}
+    # the state-dir cluster totals ride along (minus the raw shard rows)
+    assert out["cluster"]["cluster_queue_depth"] == 3
+    # and the per-shard rows never retain the raw metrics payload (the
+    # merged view is the product; rows stay scannable)
+    assert all("metrics" not in r for r in out["shards"].values())
+
+
+def test_cluster_view_clamps_future_ts_to_age_zero(tmp_path):
+    """A publisher whose clock runs AHEAD (cross-host skew) must read as
+    freshly published — age 0.0, live — not as negative-age/stale."""
+    d = str(tmp_path / "state")
+    fleet.publish_shard_state(d, "alpha", {"queue_depth": 2})
+    p = os.path.join(d, "shard_alpha.json")
+    doc = json.loads(open(p).read())
+    doc["ts"] += 3600  # one hour in the future
+    with open(p, "w") as f:
+        json.dump(doc, f)
+    view = fleet.cluster_view(d)
+    assert view["shards"]["alpha"]["age_sec"] == 0.0
+    assert view["shards"]["alpha"]["stale"] is False
+    assert view["live_shards"] == 1 and view["cluster_queue_depth"] == 2
+
+
+def test_cluster_view_default_strips_embedded_metrics(tmp_path):
+    """The /healthz fleet block is UNAUTHENTICATED: a shard that
+    published its metrics snapshot (tenant-labeled series) must not have
+    it ride the default view; the collector opts in explicitly."""
+    d = str(tmp_path / "state")
+    fleet.publish_shard_state(
+        d, "alpha", {"queue_depth": 0, "metrics": {
+            "counters": {"service.device_seconds{tenant=secret}": 1.0}}})
+    assert "metrics" not in fleet.cluster_view(d)["shards"]["alpha"]
+    withm = fleet.cluster_view(d, include_metrics=True)
+    assert "counters" in withm["shards"]["alpha"]["metrics"]
+
+
+def test_publish_shard_state_failure_is_counted_never_raised(tmp_path):
+    """satellite: a failing publish (state dir path occupied by a FILE)
+    must not raise, must increment fleet.state_publish_errors, and must
+    warn exactly once per process."""
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("x")
+    before = obs_metrics.counter("fleet.state_publish_errors").value
+    saved = fleet._publish_warned
+    fleet._publish_warned = False
+    try:
+        fleet.publish_shard_state(str(blocker), "alpha", {})
+        fleet.publish_shard_state(str(blocker), "alpha", {})
+    finally:
+        fleet._publish_warned = saved
+    after = obs_metrics.counter("fleet.state_publish_errors").value
+    assert after == before + 2
+
+
+def test_fleet_metrics_text_uses_fleet_prefix():
+    h = obs_metrics.Histogram("service.queue_wait_sec", {"tenant": "t0"})
+    h.observe(0.5)
+    merged = obs_metrics.merge_snapshots([{
+        "counters": {"engine.batches": 5},
+        "gauges": {"g.x": 2},
+        "histograms": {"service.queue_wait_sec{tenant=t0}":
+                       _hist_snapshot_entry(h)},
+    }])
+    text = fleet_view.fleet_metrics_text(merged)
+    assert "mplc_fleet_engine_batches 5" in text
+    assert 'mplc_fleet_service_queue_wait_sec_bucket{le="+Inf",' \
+           'tenant="t0"} 1' in text
+    # federation double-count protection: no bare mplc_engine_... series
+    assert "\nmplc_engine_batches" not in text
+
+
+def test_redact_varz_hashes_fleet_topology_keeps_load_scalars():
+    from mplc_tpu.obs import export
+    doc = {"fleet": {"shards": {"alpha": {"shard": "alpha",
+                                          "queue_depth": 3,
+                                          "stale": False}},
+                     "least_loaded": "alpha", "shard_id": "alpha"},
+           "shards": {"peer:h1:9090": {"peer": "h1:9090", "ok": True,
+                                       "queue_depth": 1}}}
+    out = export.redact_varz(doc, viewer="tenantA", key="master")
+    fv = out["fleet"]
+    assert "alpha" not in fv["shards"]
+    (tag,) = fv["shards"]
+    assert tag.startswith("shard-")
+    row = fv["shards"][tag]
+    assert row["shard"] == tag  # same identity -> same opaque tag
+    assert row["queue_depth"] == 3 and row["stale"] is False
+    assert fv["least_loaded"] == tag and fv["shard_id"] == tag
+    (ptag,) = out["shards"]
+    prow = out["shards"][ptag]
+    assert prow["peer"].startswith("shard-") and "h1" not in prow["peer"]
+    assert prow["queue_depth"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the incident bundle
+# ---------------------------------------------------------------------------
+
+def test_killed_shard_yields_exactly_one_incident_bundle(tmp_path):
+    """A shard killed mid-sweep (crash@batch1 — InjectedCrash is a
+    BaseException, simulating a process kill) fails the fleet run AND
+    leaves exactly ONE incident dir bundling the dead shard's flight
+    dump, trace tail, ledger digest and the cluster snapshot."""
+    from pathlib import Path
+    repo = Path(__file__).resolve().parents[1]
+    env = {"PYTHONPATH": str(repo),
+           "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+           "HOME": os.environ.get("HOME", "/root"),
+           "JAX_PLATFORMS": "cpu",
+           "MPLC_TPU_SYNTH_SCALE":
+               os.environ.get("MPLC_TPU_SYNTH_SCALE", "0.02"),
+           "JAX_COMPILATION_CACHE_DIR": str(repo / ".jax_cache")}
+    out = tmp_path / "killed"
+    with pytest.raises(fleet.FleetError):
+        fleet.run_fleet(
+            fleet.FleetSpec(), 2, str(out), env=env, devices_per_shard=1,
+            timeout=600,
+            per_shard_env={1: {"MPLC_TPU_FAULT_PLAN": "crash@batch1"}})
+    incidents = sorted(p for p in os.listdir(out)
+                       if p.startswith("incident_"))
+    assert len(incidents) == 1, incidents
+    inc = out / incidents[0]
+    bundle = json.loads((inc / "incident.json").read_text())
+    assert bundle["reason"] == "shard_failure"
+    assert bundle["failed_shards"] == [1]
+    art = bundle["shard_artifacts"]["1"]
+    # the dying worker's last act was a flight dump into the per-shard
+    # flight dir the coordinator injected — copied into the bundle
+    assert art["flight_dumps"], art
+    assert all((inc / name).exists() for name in art["flight_dumps"])
+    dump = json.loads((inc / art["flight_dumps"][0]).read_text())
+    assert dump["reason"] == "fleet_worker_crash"
+    # trace tail of the killed shard's stream, beside it
+    assert (inc / art["trace_tail"]).exists()
+    assert art["trace_tail_records"] > 0
+    assert art["log_tail"]
+    # the crash fired before the ledger was written — the digest honestly
+    # reports its absence rather than inventing one
+    assert art["ledger_digest"] is None
+    # cluster snapshot: shard 0 finished (fresh), shard 1 did not
+    cl = bundle["cluster"]
+    assert cl["shards"]["shard0"]["fresh"] is True
+    # the failure is counted and the incident event is registered
+    assert obs_metrics.counter("fleet.incidents").value >= 1
+    # the healthy shard's trace stream + the coordinator's landed too,
+    # so a manual fleet_trace_merge over the failed run still works
+    merged = fleet_view.merge_fleet_traces(str(out))
+    assert merged["shard_tracks"] >= 1

@@ -213,9 +213,16 @@ def test_metrics_snapshot_correctness():
     assert snap["gauges"]["hw"] == 10
     # 0.5 and 1.0 sit exactly on log2 bucket bounds, so the estimates
     # are exact here
-    assert snap["histograms"]["h"] == {
+    h = dict(snap["histograms"]["h"])
+    buckets = h.pop("bucket_counts")
+    assert h == {
         "count": 3, "sum": 1.5, "min": 0.0, "max": 1.0, "mean": 0.5,
         "p50": 0.5, "p95": 1.0, "p99": 1.0}
+    # the raw per-bucket counts ride the snapshot (the fleet merge's
+    # exactness hinges on them): one slot per bound plus +Inf, and they
+    # account for every observation
+    assert len(buckets) == len(metrics.LOG_BUCKET_BOUNDS) + 1
+    assert sum(buckets) == 3
     # registry is get-or-create; a name can't silently change type
     with pytest.raises(TypeError):
         metrics.gauge("c")
