@@ -265,6 +265,13 @@ _WORKLOAD_KNOBS = (
     "MPLC_TPU_MAX_CAP_HALVINGS", "MPLC_TPU_MAX_RETRIES",
     "MPLC_TPU_NO_SLOTS", "MPLC_TPU_PARTNER_FAULT_PLAN",
     "MPLC_TPU_PARTNER_SHARDS", "MPLC_TPU_PIPELINE_BATCHES",
+    # the raw-speed plane: precision changes the training/reconstruction
+    # arithmetic itself (a bf16 number and an fp32 number are different
+    # measurements — the sidecar's precision block carries the ledger
+    # proof); the kernel knob swaps the reconstruction executable; the
+    # planner knobs change WHICH estimator a method="auto" query runs
+    "MPLC_TPU_PLANNER_ACCURACY", "MPLC_TPU_PLANNER_DEADLINE_SEC",
+    "MPLC_TPU_PRECISION", "MPLC_TPU_RECON_KERNEL",
     "MPLC_TPU_RETRY_BACKOFF_SEC", "MPLC_TPU_SEED_ENSEMBLE",
     # the service knobs reshape the multi-tenant workload (injected
     # faults incl. chaos mode, slice granularity, admission bounds,
@@ -741,6 +748,12 @@ def _write_telemetry(payload: dict, repo_root: str | None = None) -> None:
             # fingerprint + per-subset v(S) bits) — what the bench_diff
             # `numerics` gate compares across runs
             payload.setdefault("numerics", _NUMERICS_SIDECAR["block"])
+        if _PRECISION_SIDECAR.get("block"):
+            # the mixed-precision proof obligation: a non-fp32 run's
+            # fp32-reference ledger diff (ulp histogram + tau-b) and
+            # both wall-clocks — bench_diff's precision.tau_b row gates
+            # on it
+            payload.setdefault("precision", _PRECISION_SIDECAR["block"])
         write_report(path, payload)
         print(f"[bench] telemetry sidecar: {path}", file=sys.stderr,
               flush=True)
@@ -752,6 +765,10 @@ def _write_telemetry(payload: dict, repo_root: str | None = None) -> None:
 # the last measured engine's ledger digest, attached to the sidecar by
 # _write_telemetry (None when MPLC_TPU_NUMERICS_LEDGER is unset)
 _NUMERICS_SIDECAR: dict = {"block": None}
+
+# the mixed-precision ledger-pair block (None on fp32 runs), attached to
+# the sidecar by _write_telemetry — see _note_precision
+_PRECISION_SIDECAR: dict = {"block": None}
 
 
 def _note_numerics(engine) -> None:
@@ -766,6 +783,90 @@ def _note_numerics(engine) -> None:
         "entries": len(led.entries),
         "values": led.values_bits(),
     }
+
+
+def _ledger_from_engine(engine):
+    """The engine's value ledger, or an in-memory one built from its
+    harvested v(S) table when MPLC_TPU_NUMERICS_LEDGER is unset — the
+    precision pair must not depend on the ledger knob being on."""
+    led = getattr(engine, "numerics_ledger", None)
+    if led is not None and led.entries:
+        return led
+    import hashlib
+
+    from mplc_tpu.obs import numerics as obs_num
+    fp = hashlib.sha256(json.dumps(
+        engine._fingerprint(), sort_keys=True).encode()).hexdigest()[:16]
+    led = obs_num.ValueLedger(fp, meta={
+        "precision": getattr(engine._multi_cfg, "precision", "fp32")})
+    for s, v in engine.charac_fct_values.items():
+        if s:  # the empty coalition's 0.0 carries no information
+            led.record(s, float(v))
+    return led
+
+
+def _note_precision(timed, make_scenario):
+    """The non-fp32 proof obligation (documented-deviation semantics,
+    like STEP_WIDTH_MULT): a bf16/mixed bench run re-evaluates the SAME
+    coalitions through an fp32 reference twin — sharing the timed
+    engine's device data, compiles excluded via the span collector — and
+    embeds the ledger diff (ulp histogram + Kendall tau-b) plus both
+    wall-clocks in the sidecar. The speed number never ships without its
+    numerics bill; bench_diff's precision.tau_b row gates on it."""
+    from mplc_tpu import constants
+    prec = getattr(timed._multi_cfg, "precision", "fp32")
+    if prec == "fp32":
+        return
+    from mplc_tpu.contrib.engine import CharacteristicEngine
+    from mplc_tpu.obs import numerics as obs_num
+    from mplc_tpu.obs import trace as obs_trace
+    from mplc_tpu.obs.report import sweep_report
+
+    coalitions = sorted(s for s in timed.charac_fct_values if s)
+    if not coalitions:
+        return
+    print(f"[bench] precision={prec}: running the fp32 reference twin "
+          f"over the same {len(coalitions)} coalitions...",
+          file=sys.stderr, flush=True)
+    old = os.environ.get(constants.PRECISION_ENV)
+    os.environ[constants.PRECISION_ENV] = "fp32"
+    try:
+        ref_sc = make_scenario()
+        ref = _attach_progress(
+            CharacteristicEngine(ref_sc, share_data_from=timed),
+            "fp32-ref")
+        with obs_trace.collect() as rtele:
+            t0 = time.perf_counter()
+            ref.evaluate(coalitions)
+            ref_s = time.perf_counter() - t0
+    finally:
+        if old is None:
+            os.environ.pop(constants.PRECISION_ENV, None)
+        else:
+            os.environ[constants.PRECISION_ENV] = old
+    # the reference twin was never warmed: subtract its compile spans so
+    # the recorded fp32 second is an executed-sweep second, comparable
+    # to the warmed timed run
+    ref_compile_s = sweep_report(rtele)["wallclock"]["compile_s"]
+    ref_exec_s = max(ref_s - ref_compile_s, 0.0)
+    diff = obs_num.diff_ledgers(_ledger_from_engine(timed),
+                                _ledger_from_engine(ref))
+    tau = diff.get("kendall_tau")
+    _PRECISION_SIDECAR["block"] = {
+        "mode": prec,
+        "fp32_reference_s": ref_exec_s,
+        "fp32_reference_compile_s": ref_compile_s,
+        "tau_b": tau,
+        "ulp": diff["ulp"],
+        "histogram": diff["histogram"],
+        "common": diff["common"],
+        "drift": diff["drift"],
+    }
+    print("[bench] precision pair: tau_b="
+          + (f"{tau:.3f}" if tau is not None else "n/a")
+          + f"  max_ulp={diff['ulp']['max']}  fp32_ref={ref_exec_s:.1f}s"
+          f" (+{ref_compile_s:.1f}s residual compile)",
+          file=sys.stderr, flush=True)
 
 
 def _degraded_run(rep: dict) -> bool:
@@ -847,6 +948,8 @@ def bench_exact_shapley(epochs, dtype):
     _throughput_note(timed, elapsed, flops, fleet_peak)
     metric = f"exact_shapley_{dataset}_{n_partners}partners_{epochs}epochs_wallclock"
     _note_numerics(timed)
+    _note_precision(timed, lambda: _make_scenario(dataset, n_partners,
+                                                  epochs, dtype))
     from mplc_tpu.obs.report import format_report, sweep_report
     rep = sweep_report(tele, flops_per_sample=flops, peak_flops=fleet_peak,
                        hbm_bytes_per_s=fleet_hbm)
@@ -1030,9 +1133,28 @@ def bench_live(epochs, dtype):
     print(format_report(rep), file=sys.stderr, flush=True)
     metric = (f"live_query_{dataset}_{n_partners}partners_"
               f"{max_rounds}rounds_latency")
+    # reconstruction-executable provenance + the kernel's headline
+    # number: which path answered (fused Pallas kernel / interpreter /
+    # scan reference) and the final fresh-query latency it delivered —
+    # bench_diff's recon.kernel_query_s row compares THIS figure, so the
+    # path that earned it rides next to it
+    from mplc_tpu import constants as _const
+    use_kernel, interpret = game._evaluator().kernel_plan()
+    recon_block = {
+        "kernel_mode": _const.recon_kernel_mode(),
+        "use_kernel": bool(use_kernel),
+        "interpret": bool(interpret),
+        "precision": getattr(game.engine._multi_cfg, "precision", "fp32"),
+        "kernel_query_s": last_fresh,
+    }
+    print(f"[bench] recon executable: "
+          + ("pallas-kernel" if use_kernel and not interpret
+             else "pallas-interpret" if use_kernel else "scan")
+          + f" fresh_query={last_fresh:.3f}s", file=sys.stderr, flush=True)
     _write_telemetry({"metric": metric, "wallclock_s": elapsed,
                       "devices": _ndev(), "degraded": _degraded_run(rep),
-                      "latency_vs_rounds": points, "report": rep})
+                      "latency_vs_rounds": points, "recon": recon_block,
+                      "report": rep})
     _emit(metric, last_fresh, 0.0)
 
 
@@ -1331,6 +1453,10 @@ def _bench_method(dataset_name, n_partners, method, epochs, dtype,
     tag = method.lower().replace(" ", "_")
     metric = f"{tag}_{dataset_name}_{n_partners}partners_{epochs}epochs_wallclock"
     _note_numerics(timed)
+    # the estimator's sampled coalitions are seed-pinned, so the twin
+    # re-evaluates the exact subsets this run harvested
+    _note_precision(timed, lambda: _make_scenario(dataset_name, n_partners,
+                                                  epochs, dtype, corrupted))
     from mplc_tpu.obs.report import format_report, sweep_report
     rep = sweep_report(tele, flops_per_sample=flops, peak_flops=fleet_peak,
                        hbm_bytes_per_s=fleet_hbm)

@@ -47,6 +47,11 @@ CONTRIBUTIVITY_METHODS = [
     # v(S) costs one eval-only batch instead of a full retrain.
     "GTG-Shapley",
     "SVARM",
+    # Adaptive query planner (contrib/planner.py): routes (game size,
+    # accuracy target, deadline) to exact/GTG/SVARM/DPVS-pruned using
+    # banked devcost estimates; the resolved plan is journaled so a
+    # replay runs the same concrete method.
+    "auto",
 ]
 
 # Dataset tags (reference: mplc/constants.py:46-52)
@@ -348,6 +353,92 @@ DETERMINISTIC_REDUCE_ENV = "MPLC_TPU_DETERMINISTIC_REDUCE"
 NUMERICS_AUDIT_ENV = "MPLC_TPU_NUMERICS_AUDIT"
 NUMERICS_LEDGER_ENV = "MPLC_TPU_NUMERICS_LEDGER"
 
+# Raw-speed plane (mpl/engine.py, ops/recon_kernel.py, contrib/planner.py)
+# — optimizations LICENSED by the numeric-truth plane: every documented
+# deviation they introduce is bounded by the value ledger (ulp histogram)
+# and the ranking tau-b gate in scripts/bench_diff.py:
+#   MPLC_TPU_PRECISION         fp32 (default) | mixed | bf16. Resolved
+#                              into TrainConfig at construction time and
+#                              part of the coalition-cache fingerprint,
+#                              exactly like MPLC_TPU_DETERMINISTIC_REDUCE.
+#                              fp32 keeps the compiled programs
+#                              byte-identical to the pre-knob build.
+#                              `mixed` runs model compute (fwd/bwd) in
+#                              bf16 with fp32 master params, optimizer
+#                              state and FedAvg aggregation — the
+#                              recorded update stream and the
+#                              reconstruction scan stay fp32. `bf16`
+#                              additionally accumulates the
+#                              reconstruction scan in bf16 (fp32 init
+#                              params cast once at scan entry). Both are
+#                              documented deviations: a non-fp32
+#                              bench/sweep run MUST carry an fp32
+#                              reference ledger pair (ulp histogram +
+#                              Kendall tau-b) in its telemetry sidecar.
+#   MPLC_TPU_RECON_KERNEL      auto (default) | off | force | interpret.
+#                              Selects the fused Pallas reconstruction
+#                              kernel (ops/recon_kernel.py) for the
+#                              retrain-free batch-eval path: `auto` uses
+#                              it when the backend is TPU, `off` always
+#                              runs the per-round lax.scan reference,
+#                              `force` requires the kernel (raises where
+#                              Pallas cannot lower), `interpret` runs the
+#                              kernel in Pallas interpret mode on any
+#                              backend (the parity-test path). The chosen
+#                              path is part of the ProgramBank recon key.
+#   MPLC_TPU_PLANNER_ACCURACY  default accuracy target (trust-row CI
+#                              half-width on normalized scores) the
+#                              adaptive planner contracts for when a
+#                              query says method="auto" without an
+#                              explicit accuracy_target. Default 0.02.
+#   MPLC_TPU_PLANNER_DEADLINE_SEC
+#                              default deadline the planner budgets
+#                              against for method="auto" queries;
+#                              0/unset = no deadline (the loose-deadline
+#                              routing row). An explicit deadline_sec
+#                              argument wins.
+PRECISION_ENV = "MPLC_TPU_PRECISION"
+RECON_KERNEL_ENV = "MPLC_TPU_RECON_KERNEL"
+PLANNER_ACCURACY_ENV = "MPLC_TPU_PLANNER_ACCURACY"
+PLANNER_DEADLINE_ENV = "MPLC_TPU_PLANNER_DEADLINE_SEC"
+
+PRECISION_MODES = ("fp32", "mixed", "bf16")
+RECON_KERNEL_MODES = ("auto", "off", "force", "interpret")
+
+
+def precision_mode() -> str:
+    """MPLC_TPU_PRECISION with the warn+fallback contract of the other
+    parsed knobs: an unrecognized value warns once per read and falls
+    back to fp32 (never silently changes what a run computes). Read at
+    TrainConfig-construction time and frozen into the config, so the
+    precision a trainer compiled with can never drift from the one its
+    cache fingerprint names."""
+    raw = _os.environ.get(PRECISION_ENV, "").strip().lower()
+    if not raw:
+        return "fp32"
+    if raw not in PRECISION_MODES:
+        import warnings
+        warnings.warn(
+            f"{PRECISION_ENV}={raw!r} is not one of {PRECISION_MODES}; "
+            "falling back to fp32", stacklevel=2)
+        return "fp32"
+    return raw
+
+
+def recon_kernel_mode() -> str:
+    """MPLC_TPU_RECON_KERNEL (warn+fallback to `auto`). Read when a
+    ReconstructionEvaluator builds its batch-eval program."""
+    raw = _os.environ.get(RECON_KERNEL_ENV, "").strip().lower()
+    if not raw:
+        return "auto"
+    if raw not in RECON_KERNEL_MODES:
+        import warnings
+        warnings.warn(
+            f"{RECON_KERNEL_ENV}={raw!r} is not one of "
+            f"{RECON_KERNEL_MODES}; falling back to auto", stacklevel=2)
+        return "auto"
+    return raw
+
 # Fleet sweep plane (mplc_tpu/parallel/fleet.py): coalition-axis
 # sharding of one sweep across OS processes/hosts, merged with a
 # ledger-verified equality proof:
@@ -573,6 +664,15 @@ ENV_KNOBS = {
     # both reshape what a measured run computes or pays
     "MPLC_TPU_DETERMINISTIC_REDUCE": "workload",
     "MPLC_TPU_NUMERICS_AUDIT": "workload",
+    # the raw-speed knobs change what a run computes (precision: v(S)
+    # itself in documented-deviation modes; kernel: the reconstruction
+    # program dispatched; planner defaults: WHICH estimator an auto
+    # query resolves to) — none may leak into a cached replay or the
+    # CPU-fallback child
+    "MPLC_TPU_PRECISION": "workload",
+    "MPLC_TPU_RECON_KERNEL": "workload",
+    "MPLC_TPU_PLANNER_ACCURACY": "workload",
+    "MPLC_TPU_PLANNER_DEADLINE_SEC": "workload",
     # the ledger is pure observability output: recording harvested value
     # bits changes nothing the run computes or pays, but the CPU-fallback
     # child must not write over the parent's ledger file

@@ -435,10 +435,16 @@ class ProgramBank:
         if donates is None:
             donates = buffer_donation_enabled()
         n_dev = eng._sharding.num_devices if eng._sharding else 1
+        # the precision mode and the kernel-vs-scan routing are part of
+        # the program identity: a bf16 (or fused-kernel) executable must
+        # never serve an fp32 (or scan) query from a shared bank
+        kernel = list(evaluator.kernel_plan()) \
+            if hasattr(evaluator, "kernel_plan") else [False, False]
         raw = json.dumps([self._engine_digest(), "recon",
                           int(rec.weights.shape[0]), eng.partners_count,
                           int(width), bool(donates), n_dev,
-                          jax.default_backend()])
+                          jax.default_backend(),
+                          getattr(evaluator, "precision", "fp32"), kernel])
         return hashlib.sha256(raw.encode()).hexdigest()[:24]
 
     def _compile_recon_bundle(self, evaluator, width: int) -> dict:

@@ -776,6 +776,27 @@ class Contributivity:
                       "method": method}
         obs_trace.event("contrib.trust", **self.trust)
 
+    def exact_reconstructed(self, alpha=0.95):
+        """Exact Shapley over RECONSTRUCTED coalition models: the full
+        2^P - 1 powerset evaluated through the shared
+        ReconstructionEvaluator (eval-only batches; the one recorded
+        grand-coalition run is the only training), then the exact
+        closed-form Shapley sum. The adaptive planner's `exact` row —
+        zero sampling error, so the trust contract is met by
+        construction (scores_std is exactly zero)."""
+        t0 = self._method_span("exact (reconstructed)")
+        logger.info("# Launching exact Shapley over reconstructed models")
+        n = self._n
+        try:
+            recon = self._reconstructor()
+        except BaseException:
+            # same span hygiene as GTG_Shapley/SVARM
+            t0.cancel()
+            raise
+        recon.evaluate(powerset_order(n))
+        sv = np.asarray(shapley_from_characteristic(n, recon.values))
+        self._finish("exact (reconstructed)", sv, np.zeros(n), t0)
+
     def GTG_Shapley(self, sv_accuracy=0.01, alpha=0.95, truncation=None,
                     perm_batch=16, min_iter=100):
         """GTG-Shapley (arXiv:2109.02053): truncated-permutation Shapley
@@ -1091,7 +1112,34 @@ class Contributivity:
     # ------------------------------------------------------------------
 
     def compute_contributivity(self, method_to_compute, sv_accuracy=0.01,
-                               alpha=0.95, truncation=0.05, update=50):
+                               alpha=0.95, truncation=0.05, update=50,
+                               accuracy_target=None, deadline_sec=None):
+        if method_to_compute == "auto":
+            # adaptive planner (contrib/planner.py): resolve the triple
+            # (game size, accuracy target, deadline) to a concrete
+            # estimator, journal the plan (the `contrib.plan` event — the
+            # sweep service copies it into the WAL and the terminal
+            # service.job event), then dispatch the CONCRETE method so a
+            # replay of the journaled plan never re-plans
+            from .planner import estimate_eval_seconds, plan_query
+            eval_sec, basis = estimate_eval_seconds(self.engine)
+            plan = plan_query(self._n, accuracy_target, deadline_sec,
+                              eval_sec=eval_sec, cost_basis=basis,
+                              live=False)
+            self.plan = plan
+            obs_trace.event("contrib.plan", **plan.describe())
+            if plan.method == "exact":
+                # the planner's exact row is the retrain-free exact
+                # powerset (reconstructed models + exact Shapley), i.e.
+                # GTG's machinery run to exhaustion — not the 2^P
+                # RETRAINING sweep ("Shapley values"), whose cost model
+                # is a different regime entirely
+                self.exact_reconstructed(alpha=alpha)
+            elif plan.method == "GTG-Shapley":
+                self.GTG_Shapley(alpha=alpha, **plan.method_kw)
+            else:
+                self.SVARM(alpha=alpha, **plan.method_kw)
+            return
         fedavg_only = ("Federated SBS linear", "Federated SBS quadratic",
                        "Federated SBS constant")
         if method_to_compute in fedavg_only and \

@@ -699,6 +699,10 @@ class CharacteristicEngine:
                     "reduction_mode": ("deterministic"
                                        if multi_cfg.deterministic_reduce
                                        else "default"),
+                    # a bf16 ledger and an fp32 ledger are different
+                    # measurements of the same game: diff_ledgers
+                    # consumers read the mode from meta
+                    "precision": multi_cfg.precision,
                     "slot_bucketing": scenario.slot_bucketing,
                 },
                 path=_ledger_path)
@@ -2002,6 +2006,11 @@ class CharacteristicEngine:
                 self._partner_faults),
             "seed_ensemble": self.seed_ensemble,
             "compute_dtype": cfg.compute_dtype,
+            # non-fp32 precision modes are documented deviations that
+            # change v(S) (bf16 compute / bf16 reconstruction
+            # accumulate): a stale fp32 cache must refuse to serve a
+            # bf16 game and vice versa
+            "precision": cfg.precision,
             "split": [str(getattr(sc, "samples_split_type", "?")),
                       str(getattr(sc, "samples_split_description", "?"))],
             "corruption": [str(c) for c in
@@ -2136,6 +2145,8 @@ class CharacteristicEngine:
         # pre-numerics caches ran the only reduction there was — the
         # default order-sensitive one
         theirs.setdefault("deterministic_reduce", False)
+        # pre-precision caches ran the only precision there was — fp32
+        theirs.setdefault("precision", "fp32")
         ours = self._fingerprint()
         if "partners_count" in theirs and \
                 theirs["partners_count"] != ours["partners_count"]:

@@ -1,0 +1,228 @@
+"""Adaptive query planner: route a contributivity query to an estimator.
+
+Method choice among the registered estimators has always been manual —
+the operator picks exact/GTG-Shapley/SVARM (and a DPVS pruning tau) per
+query and discovers too late that an exact sweep blows a deadline or
+that a sampled estimator was pointless on a 6-partner game. This module
+makes `method="auto"` a first-class request: a `(game size,
+accuracy_target, deadline_sec)` triple resolves — deterministically,
+from written-down rules — to a concrete QueryPlan that is journaled
+wherever it is used (`contrib.plan` / `service.job` events, the service
+WAL), so a replay runs the SAME concrete method and kwargs, never a
+re-plan under different meter state.
+
+Cost model: the per-coalition eval-seconds estimate comes from measured
+truth when any exists, in a ranked basis order mirroring
+`obs/devcost.estimate_device_seconds`:
+
+  meter           the engine DeviceMeter's eval-only span rate
+                  (reconstruction batches billed at host span) — real
+                  measured seconds per coalition on THIS engine
+  bank_cost_model the ProgramBank manifest's XLA-costed flops for banked
+                  reconstruction programs over the fleet's peak (a
+                  conservative per-program upper bound on per-coalition
+                  cost: the modeled program evaluates a whole batch)
+  default         a fixed conservative constant, when nothing has run yet
+
+Accuracy contract: `accuracy_target` is the trust-row CI half-width on
+normalized scores the caller is asking for
+(`MPLC_TPU_PLANNER_ACCURACY` default). The sampled estimators receive
+it as their stopping threshold (GTG's `sv_accuracy`); exact queries
+satisfy any target by construction (CI width 0); the planner grid test
+asserts the delivered trust-row CI width meets the contracted target.
+
+Routing table (tested in tests/test_planner.py; deterministic given the
+inputs, every row carries its reason):
+
+  1. exact        P <= MAX_EXACT_PARTNERS and the 2^P - 1 sweep fits the
+                  deadline (no deadline = loose: any exact-capable game
+                  routes exact).
+  2. GTG-Shapley  the truncated-permutation budget (min_iter x P evals)
+                  fits the deadline (or no deadline on a big game).
+  3. SVARM        tighter deadlines: its explicit sample budget is
+                  clamped to what the deadline affords (anchors +
+                  stratum warm-up + at least the 128-sample floor).
+  4. DPVS-pruned  deadlines below even SVARM's floor: GTG over the
+                  pruned game (live tier; non-live falls back to
+                  floor-budget SVARM, best-effort, reason says so).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .. import constants
+
+#: per-coalition eval seconds when nothing measured/modeled is available
+DEFAULT_EVAL_SEC = 0.05
+#: assumed MXU utilization when deriving seconds from modeled flops
+_COST_MODEL_MFU = 0.10
+#: default DPVS tau for the pruned fallback rung (an explicit
+#: MPLC_TPU_LIVE_PRUNE_TAU wins at query time, like any live query)
+_PRUNE_TAU_FALLBACK = 0.5
+#: SVARM's minimum useful sampled budget (mirrors its 128-sample floor)
+_SVARM_FLOOR = 128
+#: GTG's default permutation budget per partner (min_iter default)
+_GTG_MIN_ITER = 100
+
+MAX_EXACT_PARTNERS = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryPlan:
+    """One resolved plan: everything a replay needs to run the same
+    concrete query, plus the cost/accuracy evidence behind the choice."""
+    method: str                    # concrete estimator ("exact"/"GTG-Shapley"/"SVARM")
+    partners: int
+    accuracy_target: float         # contracted trust-row CI half-width
+    deadline_sec: "float | None"   # None = loose
+    est_evals: int                 # estimated coalition evaluations
+    est_eval_sec: float            # per-coalition eval-seconds estimate
+    est_cost_sec: float            # est_evals * est_eval_sec
+    cost_basis: str                # "meter" | "bank_cost_model" | "default"
+    prune_tau: float               # 0 = unpruned
+    reason: str
+    method_kw: dict = dataclasses.field(default_factory=dict)
+
+    def describe(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["method_kw"] = dict(self.method_kw)
+        return d
+
+
+def plan_from_dict(doc: dict) -> QueryPlan:
+    """Rebuild a journaled plan (service WAL replay / report tooling)."""
+    fields = {f.name for f in dataclasses.fields(QueryPlan)}
+    return QueryPlan(**{k: v for k, v in doc.items() if k in fields})
+
+
+def estimate_eval_seconds(engine=None) -> tuple:
+    """(seconds-per-coalition-eval, basis), best available truth first."""
+    meter = getattr(engine, "device_meter", None) if engine else None
+    if meter is not None:
+        snap = meter.snapshot()
+        if snap.get("eval_coalitions", 0) >= 8 and \
+                snap.get("eval_span_sec", 0.0) > 0.0:
+            return (snap["eval_span_sec"] / snap["eval_coalitions"],
+                    "meter")
+    bank = getattr(engine, "program_bank", None) if engine else None
+    if bank is not None:
+        try:
+            from ..obs.devcost import fleet_peak_flops
+            peak = fleet_peak_flops()
+            costs = [c.get("flops", 0.0)
+                     for c in bank.persistent_costs().values()
+                     if c.get("flops")]
+            if peak and costs:
+                # a banked program's modeled flops cover a whole batch:
+                # per-coalition cost is bounded above by it, so this
+                # basis over-estimates (deadline-safe direction)
+                return (float(np.median(costs)) / (peak * _COST_MODEL_MFU),
+                        "bank_cost_model")
+        except Exception:
+            pass
+    return (DEFAULT_EVAL_SEC, "default")
+
+
+def _estimated_evals(partners: int) -> dict:
+    """Estimated coalition-eval budgets per estimator family."""
+    n = int(partners)
+    warmup = max(n * n - 2 * n, 0)  # SVARM per-(partner, size) strata
+    return {
+        "exact": (1 << n) - 1,
+        "GTG-Shapley": _GTG_MIN_ITER * n,
+        # anchors (2n) + stratum warm-up + the sampled floor
+        "SVARM_floor": 2 * n + warmup + _SVARM_FLOOR,
+        "SVARM_auto": 2 * n + warmup + max(4 * n * n, _SVARM_FLOOR),
+    }
+
+
+def default_accuracy_target() -> float:
+    t = constants._env_nonneg_float(constants.PLANNER_ACCURACY_ENV, 0.0)
+    return t if t > 0 else 0.02
+
+
+def default_deadline_sec() -> "float | None":
+    d = constants._env_nonneg_float(constants.PLANNER_DEADLINE_ENV, 0.0)
+    return d if d > 0 else None
+
+
+def plan_query(partners_count: int,
+               accuracy_target: "float | None" = None,
+               deadline_sec: "float | None" = None, *,
+               eval_sec: "float | None" = None,
+               cost_basis: str = "default",
+               live: bool = False) -> QueryPlan:
+    """Resolve `method="auto"` to a concrete QueryPlan (routing table in
+    the module docstring). Pure given its inputs — callers pass the
+    measured `eval_sec` (from `estimate_eval_seconds`) so the decision
+    is reproducible from the journaled plan alone."""
+    n = int(partners_count)
+    if n < 1:
+        raise ValueError(f"partners_count must be >= 1, got {n}")
+    if accuracy_target is None:
+        accuracy_target = default_accuracy_target()
+    if deadline_sec is None:
+        deadline_sec = default_deadline_sec()
+    if eval_sec is None:
+        eval_sec, cost_basis = DEFAULT_EVAL_SEC, "default"
+    evals = _estimated_evals(n)
+
+    def _plan(method, est_evals, prune_tau, reason, **method_kw):
+        return QueryPlan(
+            method=method, partners=n,
+            accuracy_target=float(accuracy_target),
+            deadline_sec=None if deadline_sec is None else float(deadline_sec),
+            est_evals=int(est_evals), est_eval_sec=float(eval_sec),
+            est_cost_sec=float(est_evals) * float(eval_sec),
+            cost_basis=cost_basis, prune_tau=float(prune_tau),
+            reason=reason, method_kw=method_kw)
+
+    def _fits(est_evals):
+        return deadline_sec is None or est_evals * eval_sec <= deadline_sec
+
+    # 1. exact: zero sampling error, so it satisfies ANY accuracy target
+    if n <= MAX_EXACT_PARTNERS and _fits(evals["exact"]):
+        return _plan(
+            "exact", evals["exact"], 0.0,
+            f"2^{n}-1 exact sweep fits "
+            + ("a loose deadline" if deadline_sec is None
+               else f"the {deadline_sec:g}s deadline")
+            + "; exact Shapley meets any accuracy target (CI width 0)")
+    # 2. GTG-Shapley: permutation sampling to the accuracy target
+    if _fits(evals["GTG-Shapley"]):
+        reason = (f"game too large for the exact table (P={n} > "
+                  f"{MAX_EXACT_PARTNERS})" if n > MAX_EXACT_PARTNERS
+                  else "exact sweep would blow the deadline")
+        return _plan(
+            "GTG-Shapley", evals["GTG-Shapley"], 0.0,
+            reason + "; truncated-permutation budget fits",
+            sv_accuracy=float(accuracy_target))
+    # 3. SVARM: explicit budget clamped to the deadline
+    if _fits(evals["SVARM_floor"]):
+        affordable = int(deadline_sec / eval_sec) if deadline_sec else 0
+        overhead = evals["SVARM_floor"] - _SVARM_FLOOR
+        budget = min(max(affordable - overhead, _SVARM_FLOOR),
+                     max(4 * n * n, _SVARM_FLOOR))
+        return _plan(
+            "SVARM", overhead + budget, 0.0,
+            "deadline below the GTG permutation budget; SVARM's sample "
+            f"budget clamps to {budget} coalitions",
+            budget=int(budget))
+    # 4. pruned (live) / floor-budget SVARM (best-effort, non-live)
+    if live:
+        tau = constants._env_nonneg_float(
+            constants.LIVE_PRUNE_TAU_ENV, 0.0) or _PRUNE_TAU_FALLBACK
+        tau = min(tau, 1.0)
+        return _plan(
+            "GTG-Shapley", evals["GTG-Shapley"] // 2, tau,
+            "deadline below every unpruned estimator's floor; DPVS "
+            f"pruning at tau={tau:g} collapses low-information partners",
+            sv_accuracy=float(accuracy_target))
+    return _plan(
+        "SVARM", evals["SVARM_floor"], 0.0,
+        "deadline below every estimator's floor — best-effort SVARM at "
+        "the minimum sample budget (expect the deadline to be missed)",
+        budget=_SVARM_FLOOR)

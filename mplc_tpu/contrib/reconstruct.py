@@ -57,6 +57,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import constants
 from .. import faults
 from ..mpl.engine import MplTrainer
 from ..obs import metrics as obs_metrics
@@ -220,9 +221,28 @@ class ReconstructionEvaluator:
             else record_updates(engine)
         self.values: dict[tuple, float] = {(): 0.0}
         self.reconstructions = 0
+        # the engine's frozen precision mode, captured once: the memo, the
+        # reconstruction programs and the banked executables all answer
+        # for exactly this mode (a bf16 answer must never serve an fp32
+        # query — the live tier keys its query cache on this too)
+        self.precision = getattr(engine._multi_cfg, "precision", "fp32")
+        # fused-kernel routing (MPLC_TPU_RECON_KERNEL, resolved when the
+        # first program is built): (use_kernel, interpret). Part of the
+        # ProgramBank recon key — a scan executable and a kernel
+        # executable are different programs
+        self._kernel = None
         self._fn = None
+        self._fn_cpu = None
         self._fn_donates = None
         self._cpu_rec = None
+
+    def kernel_plan(self) -> tuple:
+        """(use_kernel, interpret) for this evaluator, resolved once from
+        MPLC_TPU_RECON_KERNEL + the backend (ops/recon_kernel.resolve)."""
+        if self._kernel is None:
+            from ..ops import recon_kernel
+            self._kernel = recon_kernel.resolve(constants.recon_kernel_mode())
+        return self._kernel
 
     def reset_recorded(self, recorded: RecordedRun) -> None:
         """Swap in a new recorded stream (the live tier's round-stamp
@@ -236,36 +256,63 @@ class ReconstructionEvaluator:
 
     # -- the fused reconstruct+eval program ------------------------------
 
+    def _make_batch_eval(self, use_kernel: bool, interpret: bool):
+        """One fused reconstruct+eval program. `use_kernel=False` is the
+        per-round lax.scan reference; `use_kernel=True` routes the
+        renormalize+accumulate through the fused Pallas kernel
+        (ops/recon_kernel.py) — same contraction reassociated across
+        rounds, so values are bit-identical where fp addition happens to
+        associate and ledger-bounded otherwise."""
+        trainer = self.engine.multi_pipe.trainer
+        precision = self.precision
+
+        def batch_eval(masks, init_params, deltas, weights, test):
+            if use_kernel:
+                from ..ops import recon_kernel
+                params = recon_kernel.reconstruct_batch(
+                    masks, init_params, deltas, weights,
+                    precision=precision, interpret=interpret)
+                return jax.vmap(lambda p: trainer.evaluate(p, test)[1])(
+                    params)
+            if precision == "bf16":
+                # documented deviation (MPLC_TPU_PRECISION=bf16): the
+                # recorded stream and the carried params accumulate in
+                # bf16; the per-round renormalize stays fp32 (tiny)
+                init_params = jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.bfloat16), init_params)
+                deltas = jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.bfloat16), deltas)
+
+            def one(mask):
+                def round_step(params, xs):
+                    delta, w = xs          # [P, ...] leaves, [P]
+                    ws = w * mask
+                    denom = jnp.sum(ws)
+                    # rounds the recording never reached (early stop)
+                    # and rounds where no member survived carry zero
+                    # weight: the model passes through unchanged
+                    wn = jnp.where(denom > 0,
+                                   ws / jnp.maximum(denom, 1e-12), 0.0)
+                    upd = jax.tree_util.tree_map(
+                        lambda d: jnp.tensordot(
+                            wn.astype(d.dtype), d, axes=([0], [0])),
+                        delta)
+                    return jax.tree_util.tree_map(
+                        lambda p, u: p + u, params, upd), None
+
+                params, _ = lax.scan(round_step, init_params,
+                                     (deltas, weights))
+                return trainer.evaluate(params, test)[1]
+
+            return jax.vmap(one)(masks)
+
+        return batch_eval
+
     def _batch_eval_fn(self):
         if self._fn is None:
-            trainer = self.engine.multi_pipe.trainer
-
-            def batch_eval(masks, init_params, deltas, weights, test):
-                def one(mask):
-                    def round_step(params, xs):
-                        delta, w = xs          # [P, ...] leaves, [P]
-                        ws = w * mask
-                        denom = jnp.sum(ws)
-                        # rounds the recording never reached (early stop)
-                        # and rounds where no member survived carry zero
-                        # weight: the model passes through unchanged
-                        wn = jnp.where(denom > 0,
-                                       ws / jnp.maximum(denom, 1e-12), 0.0)
-                        upd = jax.tree_util.tree_map(
-                            lambda d: jnp.tensordot(
-                                wn.astype(d.dtype), d, axes=([0], [0])),
-                            delta)
-                        return jax.tree_util.tree_map(
-                            lambda p, u: p + u, params, upd), None
-
-                    params, _ = lax.scan(round_step, init_params,
-                                         (deltas, weights))
-                    return trainer.evaluate(params, test)[1]
-
-                return jax.vmap(one)(masks)
-
+            use_kernel, interpret = self.kernel_plan()
             # donate the per-batch mask buffer (argument 0) into the
-            # fused reconstruct+eval scan; the recorded stream
+            # fused reconstruct+eval program; the recorded stream
             # (init_params/deltas/weights) and the test set are REUSED
             # across every batch and must never be donated. Retry safety:
             # the dispatch closure re-materializes masks from the host
@@ -273,9 +320,31 @@ class ReconstructionEvaluator:
             from ..mpl.engine import buffer_donation_enabled
             self._fn_donates = buffer_donation_enabled()
             self._fn = jax.jit(
-                batch_eval,
+                self._make_batch_eval(use_kernel, interpret),
                 donate_argnums=(0,) if self._fn_donates else ())
         return self._fn
+
+    def _cpu_eval_fn(self):
+        """The terminal CPU rung's program. A compiled Pallas kernel
+        cannot run on the host backend, so the rung falls back to the
+        scan reference there (documented: CPU-recovered values of a
+        kernel-mode run are ledger-bounded, not bit-identical, vs the
+        kernel's); interpret-mode kernels run anywhere, so they keep the
+        rung bit-identical with the fault-free path."""
+        if self._fn_cpu is None:
+            use_kernel, interpret = self.kernel_plan()
+            if not use_kernel or interpret:
+                # same program as the main path — share the jit object so
+                # the historical (scan / interpret) rung stays literally
+                # the same function, traced per device as before
+                self._fn_cpu = self._batch_eval_fn()
+            else:
+                from ..mpl.engine import buffer_donation_enabled
+                self._fn_cpu = jax.jit(
+                    self._make_batch_eval(False, False),
+                    donate_argnums=(0,)
+                    if buffer_donation_enabled() else ())
+        return self._fn_cpu
 
     def _apply(self, masks: jax.Array) -> jax.Array:
         rec = self.recorded
@@ -304,7 +373,7 @@ class ReconstructionEvaluator:
                              put(rec.weights), put(self.engine.test))
         ip, d, w, test = self._cpu_rec
         with jax.default_device(cpu):
-            return self._batch_eval_fn()(
+            return self._cpu_eval_fn()(
                 jax.device_put(jnp.asarray(masks), cpu), ip, d, w, test)
 
     # -- estimator-facing API --------------------------------------------

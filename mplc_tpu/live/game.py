@@ -91,10 +91,10 @@ class LiveQueryResult:
 
     __slots__ = ("method", "scores", "stamp", "rounds", "seconds",
                  "evaluations", "pruned_coalitions", "prune_tau",
-                 "low_info", "trust")
+                 "low_info", "trust", "plan")
 
     def __init__(self, method, scores, stamp, rounds, seconds, evaluations,
-                 pruned_coalitions, prune_tau, low_info, trust):
+                 pruned_coalitions, prune_tau, low_info, trust, plan=None):
         self.method = method
         self.scores = np.asarray(scores)
         self.stamp = int(stamp)
@@ -105,14 +105,21 @@ class LiveQueryResult:
         self.prune_tau = float(prune_tau)
         self.low_info = tuple(low_info)
         self.trust = trust
+        # the adaptive planner's resolved QueryPlan for method="auto"
+        # queries (None for direct method queries): carries the concrete
+        # method/kwargs a replay must run
+        self.plan = plan
 
     def describe(self) -> dict:
-        return {"method": self.method, "stamp": self.stamp,
-                "rounds": self.rounds, "seconds": round(self.seconds, 6),
-                "evaluations": self.evaluations,
-                "pruned_coalitions": self.pruned_coalitions,
-                "prune_tau": self.prune_tau,
-                "scores": [float(x) for x in self.scores]}
+        d = {"method": self.method, "stamp": self.stamp,
+             "rounds": self.rounds, "seconds": round(self.seconds, 6),
+             "evaluations": self.evaluations,
+             "pruned_coalitions": self.pruned_coalitions,
+             "prune_tau": self.prune_tau,
+             "scores": [float(x) for x in self.scores]}
+        if self.plan is not None:
+            d["plan"] = self.plan.describe()
+        return d
 
 
 def _encode_tree(tree) -> list:
@@ -402,25 +409,52 @@ class LiveGame:
     # -- queries ---------------------------------------------------------
 
     def query(self, method: str = "GTG-Shapley", prune: "float | None" = None,
+              accuracy_target: "float | None" = None,
+              deadline_sec: "float | None" = None,
               **method_kw) -> LiveQueryResult:
         """Answer a contributivity query from the resident game.
 
         `method`: "exact" (full reconstructed powerset + exact Shapley;
         partner counts <= 16), "GTG-Shapley" or "SVARM" (their usual
-        kwargs pass through). `prune` is the DPVS threshold tau (None =
-        the `MPLC_TPU_LIVE_PRUNE_TAU` env default, 0 = off). Results are
-        memoized per (method, tau, kwargs) and served without any device
-        work while the round-stamp is unchanged; a stale result is never
-        served. Queries (and appends) on one game are serialized by the
-        game's lock — the service's worker pool can schedule two of a
-        tenant's quanta concurrently."""
+        kwargs pass through), or "auto" — the adaptive planner
+        (contrib/planner.py) resolves (game size, `accuracy_target`,
+        `deadline_sec`) to a concrete method + pruning tau, the plan
+        rides the result (`result.plan`) and a `live.plan` event, and
+        the plan ALONE determines the query (its prune_tau wins over the
+        env default) so a journaled plan replays bit-identically.
+        `prune` is the DPVS threshold tau (None = the
+        `MPLC_TPU_LIVE_PRUNE_TAU` env default, 0 = off). Results are
+        memoized per (method, tau, precision, kwargs) and served without
+        any device work while the round-stamp is unchanged; a stale
+        result is never served. Queries (and appends) on one game are
+        serialized by the game's lock — the service's worker pool can
+        schedule two of a tenant's quanta concurrently."""
         with self._lock:
-            return self._query_locked(method, prune, method_kw)
+            return self._query_locked(method, prune, method_kw,
+                                      accuracy_target, deadline_sec)
 
     def _query_locked(self, method: str, prune: "float | None",
-                      method_kw: dict) -> LiveQueryResult:
+                      method_kw: dict,
+                      accuracy_target: "float | None" = None,
+                      deadline_sec: "float | None" = None
+                      ) -> LiveQueryResult:
         if method == "Shapley values":
             method = "exact"
+        plan = None
+        if method == "auto":
+            from ..contrib.planner import estimate_eval_seconds, plan_query
+            eval_sec, basis = estimate_eval_seconds(self.engine)
+            plan = plan_query(self.engine.partners_count, accuracy_target,
+                              deadline_sec, eval_sec=eval_sec,
+                              cost_basis=basis, live=True)
+            method = plan.method
+            # the plan fully determines the query (replayability): its
+            # tau wins even when 0 — an env-default tau must not leak
+            # into an auto query the journaled plan doesn't mention
+            prune = plan.prune_tau
+            method_kw = {**plan.method_kw, **method_kw}
+            obs_trace.event("live.plan", tenant=self.tenant,
+                            **plan.describe())
         if method not in LIVE_METHODS:
             raise ValueError(
                 f"unknown live query method {method!r} (expected one of "
@@ -445,7 +479,12 @@ class LiveGame:
                 raise ValueError(
                     f"prune tau must be in [0, 1], got {tau}")
         n = self.engine.partners_count
-        key = (method, tau, tuple(sorted(method_kw.items())))
+        # the precision mode keys the memo: the engine's mode is frozen,
+        # but a journal-restored game can be re-opened under a different
+        # MPLC_TPU_PRECISION — a bf16 answer must never serve an fp32
+        # query (ISSUE 17's memo-keying fix, same rule as the bank key)
+        precision = getattr(self.engine._multi_cfg, "precision", "fp32")
+        key = (method, tau, precision, tuple(sorted(method_kw.items())))
         span = obs_trace.start_span(
             "live.query", tenant=self.tenant, method=method,
             rounds=self.rounds_resident, stamp=self.round_stamp,
@@ -453,6 +492,11 @@ class LiveGame:
         try:
             cached = self._results.get(key)
             if cached is not None and cached.stamp == self.round_stamp:
+                if plan is not None and cached.plan is None:
+                    # an auto query memo-hitting an earlier direct query
+                    # of the same concrete (method, tau, kwargs): the
+                    # plan describes exactly this result — attach it
+                    cached.plan = plan
                 obs_metrics.counter("live.queries").inc()
                 obs_metrics.counter("live.query_memo_hits").inc()
                 span.attrs.update(memo_hit=True, evaluations=0, pruned=0)
@@ -505,7 +549,7 @@ class LiveGame:
                 method=method, scores=scores, stamp=self.round_stamp,
                 rounds=self.rounds_resident, seconds=seconds,
                 evaluations=evals, pruned_coalitions=pruned, prune_tau=tau,
-                low_info=sorted(low), trust=trust)
+                low_info=sorted(low), trust=trust, plan=plan)
             self._results[key] = result
             self.queries += 1
             obs_metrics.counter("live.queries").inc()

@@ -208,11 +208,37 @@ class TrainConfig:
     # resolved value is part of the frozen config, the trainer-registry
     # key and the engine cache fingerprint).
     deterministic_reduce: bool | None = None
+    # Mixed-precision mode (MPLC_TPU_PRECISION, constants.precision_mode):
+    #   fp32   (default) byte-identical compiled programs to the pre-knob
+    #          build — compute_dtype alone decides the model dtype, as it
+    #          always has.
+    #   mixed  model compute (fwd/bwd matmuls, activations) in bf16 with
+    #          fp32 master params, optimizer state and FedAvg aggregation
+    #          (models/zoo.py casts params INSIDE apply, so the carried
+    #          state never leaves fp32); the recorded update stream and
+    #          the reconstruction scan stay fp32.
+    #   bf16   `mixed` plus a bf16 reconstruction accumulate: the
+    #          retrain-free batch-eval path casts the recorded deltas and
+    #          the init params to bf16 at scan entry
+    #          (contrib/reconstruct.py), trading reconstruction ulps for
+    #          bandwidth.
+    # Like STEP_WIDTH_MULT, non-fp32 modes are documented deviations:
+    # v(S) changes, so the mode is part of the trainer-registry key and
+    # the engine cache fingerprint, and every non-fp32 bench run must
+    # carry an fp32 reference ledger pair (ulp histogram + tau-b) in its
+    # sidecar. None = resolve from the env at construction time.
+    precision: str | None = None
 
     def __post_init__(self):
         if self.deterministic_reduce is None:
             object.__setattr__(self, "deterministic_reduce",
                                constants.deterministic_reduce_enabled())
+        if self.precision is None:
+            object.__setattr__(self, "precision", constants.precision_mode())
+        if self.precision not in constants.PRECISION_MODES:
+            raise ValueError(
+                f"precision must be one of {constants.PRECISION_MODES}, "
+                f"got {self.precision!r}")
         if self.approach not in APPROACH_NAMES:
             raise KeyError(
                 f"Multi-partner learning approach '{self.approach}' is not a valid "
@@ -260,6 +286,8 @@ class TrainConfig:
 
     @property
     def dtype(self):
+        if self.precision in ("mixed", "bf16"):
+            return jnp.bfloat16
         return jnp.bfloat16 if self.compute_dtype == "bfloat16" else jnp.float32
 
 

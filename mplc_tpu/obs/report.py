@@ -130,6 +130,9 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     per_method: dict = {}
     live_queries: list = []         # (dur, attrs) of live.query events
     live_appends = live_recovers = 0
+    # adaptive query planner (contrib/planner.py): every contrib.plan /
+    # live.plan event is one method="auto" resolution
+    plans: list = []
     # numeric-truth plane (obs/numerics.py): audit/drift events and the
     # last ledger-persist event
     num_audits = num_drift = 0
@@ -328,6 +331,8 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             # one persist per evaluate(); the last event carries the
             # final entry count
             num_ledger = dict(a)
+        elif name in ("contrib.plan", "live.plan"):
+            plans.append(dict(a))
         elif name == "live.query":
             live_queries.append((dur, a))
         elif name == "live.append":
@@ -614,6 +619,19 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
                 "max": fresh[-1] if fresh else None,
             },
         }
+    if plans:
+        # the adaptive-planner row: how many method="auto" requests
+        # resolved, to which concrete estimators, and the last resolved
+        # plan in full (its reason is the routing-table row that fired)
+        routed: dict = {}
+        for p in plans:
+            m = p.get("method", "?")
+            routed[m] = routed.get(m, 0) + 1
+        report["planner"] = {
+            "auto_queries": len(plans),
+            "routed": routed,
+            "last": plans[-1],
+        }
     if svc_tenants or svc_jobs:
         # the multi-tenant service view: job outcomes, the cross-tenant
         # program-packing win, and fair-share cost attribution — each
@@ -850,6 +868,17 @@ def format_report(report: dict) -> str:
             + (f"  recovered={lv['recovered_games']}"
                if lv.get("recovered_games") else "")
             + f"  query p50/p95={_s(q.get('p50'))}/{_s(q.get('p95'))}")
+    pl = report.get("planner")
+    if pl is not None:
+        last = pl.get("last") or {}
+        routed = ", ".join(f"{m}x{c}"
+                           for m, c in sorted(pl["routed"].items()))
+        lines.append(
+            f"  planner     auto={pl['auto_queries']}  routed=[{routed}]"
+            f"  last={last.get('method', '?')}"
+            f" (est {last.get('est_evals', '?')} evals"
+            f" ~{last.get('est_cost_sec', 0.0):.2f}s,"
+            f" basis {last.get('cost_basis', '?')})")
     rc = report.get("reconstruction")
     if rc is not None:
         mem = rc.get("recorded_update_bytes")

@@ -106,6 +106,11 @@ SPAN_REGISTRY = {
     "recon.record": "grand-coalition recording run (retrain-free)",
     "contributivity": "one estimator method end-to-end",
     "contrib.trust": "trust row (CIs + rank stability)",
+    "contrib.plan": "adaptive planner resolved method='auto' for a batch "
+                    "query (attrs: QueryPlan.describe() — method/"
+                    "est_evals/est_cost_sec/cost_basis/reason)",
+    "live.plan": "adaptive planner resolved method='auto' for a live "
+                 "query (attrs: tenant + QueryPlan.describe())",
     "mpl.fit": "one multi-partner fit",
     "service.submit": "job accepted onto the service queue",
     "service.reject": "admission refused (backpressure or fault plan)",

@@ -1,0 +1,209 @@
+"""Fused Pallas reconstruction kernel (the live tier's hot path).
+
+The reference reconstruction (contrib/reconstruct.py) replays the
+recorded rounds as a `lax.scan`: per round, renormalize the masked
+weights and accumulate one weighted delta into the carried params. That
+shape is R sequential small contractions — each round re-reads the
+carried params from HBM and writes them back, so the scan is bound by
+R round-trips over the param footprint plus the scan's sequential
+dispatch overhead.
+
+The kernel reassociates the whole replay into ONE contraction. The
+renormalized weight of partner p in round r depends only on the mask
+and the recorded weights:
+
+    WN[b, r, p] = w[r, p] * m[b, p] / sum_q w[r, q] * m[b, q]   (0 when
+                  the denominator is 0 — the zero-weight pass-through)
+
+so the reconstructed params are
+
+    out[b, :] = init[:] + sum_{r,p} WN[b, r, p] * delta[r, p, :]
+              = init[:] + (WN[b] flattened) @ (deltas flattened [R*P, D])
+
+— the masked-weight renormalize collapses to an O(B*R*P) elementwise
+prologue (computed in-graph, fused by XLA) and the per-round accumulate
+becomes a single [B, K] x [K, D] matmul over the flattened recorded
+stream, which this module tiles as a Pallas TPU kernel: one pass over
+the recorded deltas, MXU-contracted, accumulated block-resident in VMEM
+instead of R param-sized HBM round-trips.
+
+Numerics contract: the kernel computes the SAME sum with a different
+association (one fp32-accumulated dot instead of R sequential adds), so
+values are ledger-bounded vs the scan, not bit-identical — the value
+ledger + tau-b gate (obs/numerics.py, scripts/bench_diff.py) carry the
+proof, and the interpret-mode parity test bounds the deviation
+everywhere. Two exactnesses ARE preserved: a coalition whose every
+round has zero surviving weight reproduces `init` bit-exactly (its WN
+rows are exact zeros, the matmul contributes exact 0.0), and padding
+(batch rows, K tail, D tail) is zero-filled so padded lanes contribute
+exact zeros.
+
+Fallback rule (MPLC_TPU_RECON_KERNEL, constants.recon_kernel_mode):
+`auto` compiles the kernel on TPU backends only — CPU tier-1 runs the
+scan reference; `interpret` runs the kernel through the Pallas
+interpreter on any backend (the parity-test path); `force` requires a
+compiled kernel; `off` always runs the scan. The resolved path is part
+of the ProgramBank recon key — a scan executable never serves a kernel
+query or vice versa.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Pallas is part of the jax distribution but keep the import soft: the
+# scan fallback must survive a build without it (resolve() reports the
+# kernel unavailable instead of raising at import time).
+try:  # pragma: no cover - exercised by availability, not by absence
+    from jax.experimental import pallas as pl
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    pl = None
+    _PALLAS_OK = False
+
+
+def kernel_available() -> bool:
+    """True when the compiled (non-interpret) kernel can run here."""
+    return _PALLAS_OK and jax.default_backend() == "tpu"
+
+
+def resolve(mode: str) -> tuple:
+    """(use_kernel, interpret) for a MPLC_TPU_RECON_KERNEL mode."""
+    if mode == "off" or not _PALLAS_OK:
+        if mode == "force":
+            raise RuntimeError(
+                "MPLC_TPU_RECON_KERNEL=force but Pallas is not importable "
+                "on this toolchain")
+        return (False, False)
+    if mode == "interpret":
+        return (True, True)
+    if mode == "force":
+        return (True, False)
+    return (kernel_available(), False)  # auto
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _largest_divisor_block(n: int, candidates: tuple) -> int:
+    """Largest candidate block edge that tiles `n` exactly (the caller
+    pads to a multiple of the smallest candidate first)."""
+    for c in candidates:
+        if n % c == 0:
+            return c
+    return n
+
+
+def _recon_matmul_kernel(wn_ref, d_ref, init_ref, o_ref):
+    """One (bm, bn) output block: init + WN-block @ delta-block,
+    accumulated across the K grid axis (innermost, sequential on TPU —
+    the output block stays resident while k sweeps the recorded
+    stream)."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _seed():
+        o_ref[...] = jnp.broadcast_to(
+            init_ref[...], o_ref.shape).astype(o_ref.dtype)
+
+    o_ref[...] += jnp.dot(wn_ref[...], d_ref[...],
+                          preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",), donate_argnums=())
+def _fused_contract(wn2, d2, init, *, interpret: bool):
+    """out[B, D] = init[None, :] + wn2 @ d2 via the tiled Pallas kernel.
+
+    wn2 [B, K] and d2 [K, D] arrive already zero-padded to tile-friendly
+    shapes by the caller; init [1, D] likewise. fp32 accumulation always
+    (preferred_element_type), whatever the input dtype."""
+    B, K = wn2.shape
+    _, D = d2.shape
+    bm = _largest_divisor_block(B, (128, 64, 32, 16, 8))
+    bn = _largest_divisor_block(D, (512, 256, 128))
+    bk = _largest_divisor_block(K, (512, 256, 128))
+    grid = (B // bm, D // bn, K // bk)
+    return pl.pallas_call(
+        _recon_matmul_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((B, D), jnp.float32),
+        interpret=interpret,
+    )(wn2, d2, init)
+
+
+def normalized_round_weights(masks, weights):
+    """WN [B, R, P]: the scan's per-round masked renormalize, batched.
+    Zero-denominator rounds (early-stopped tail, no surviving member)
+    produce exact-zero rows — the pass-through rule."""
+    ws = weights[None, :, :] * masks[:, None, :]          # [B, R, P]
+    denom = jnp.sum(ws, axis=-1, keepdims=True)
+    return jnp.where(denom > 0, ws / jnp.maximum(denom, 1e-12), 0.0)
+
+
+def reconstruct_batch(masks, init_params, deltas, weights, *,
+                      precision: str = "fp32", interpret: bool = False):
+    """Reconstruct a batch of coalition models in one fused pass.
+
+    masks [B, P] float; init_params pytree; deltas pytree with leaves
+    [R, P, ...]; weights [R, P]. Returns the reconstructed params pytree
+    with a leading batch axis [B, ...], leaf dtypes matching the scan
+    path's for the given precision mode (bf16 leaves under
+    MPLC_TPU_PRECISION=bf16, the recorded dtypes otherwise).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(deltas)
+    init_leaves = jax.tree_util.tree_leaves(init_params)
+    B = masks.shape[0]
+    R, P = weights.shape
+
+    wn = normalized_round_weights(masks, weights)         # [B, R, P]
+    K = R * P
+    wn2 = wn.reshape(B, K)
+
+    # flatten every leaf's [R, P, *s] to [K, prod(s)] and contract them
+    # through ONE kernel call: the concatenated [K, D_total] layout keeps
+    # the MXU busy on one big matmul instead of a per-leaf tail of thin
+    # ones (and the per-leaf D offsets below undo it exactly)
+    sizes = [int(l.size) // K for l in leaves]
+    d2 = jnp.concatenate(
+        [l.reshape(K, -1) for l in leaves], axis=1)       # [K, D_total]
+    init_flat = jnp.concatenate(
+        [l.reshape(-1).astype(jnp.float32) for l in init_leaves])
+    D = d2.shape[1]
+
+    compute_dtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
+    wn2 = wn2.astype(compute_dtype)
+    d2 = d2.astype(compute_dtype)
+
+    # zero-pad to tile-friendly shapes (padded rows/cols contribute
+    # exact zeros; padded batch rows are sliced off below)
+    Bp = _round_up(B, 8)
+    Kp = _round_up(K, 128)
+    Dp = _round_up(D, 128)
+    wn2 = jnp.pad(wn2, ((0, Bp - B), (0, Kp - K)))
+    d2 = jnp.pad(d2, ((0, Kp - K), (0, Dp - D)))
+    init_pad = jnp.pad(init_flat, (0, Dp - D)).reshape(1, Dp)
+
+    out = _fused_contract(wn2, d2, init_pad, interpret=interpret)
+    out = out[:B, :D]
+
+    # unflatten back into per-leaf [B, *s] params, matching the scan
+    # path's carried dtype (bf16 accumulate under precision=bf16 — the
+    # kernel still sums in fp32, one rounding instead of R)
+    outs, off = [], 0
+    for leaf, init_leaf, size in zip(leaves, init_leaves, sizes):
+        part = out[:, off:off + size]
+        off += size
+        shape = (B,) + tuple(init_leaf.shape)
+        dtype = jnp.bfloat16 if precision == "bf16" else init_leaf.dtype
+        outs.append(part.reshape(shape).astype(dtype))
+    return jax.tree_util.tree_unflatten(treedef, outs)

@@ -266,6 +266,13 @@ class SweepJob:
         self._live_billed: "tuple | None" = None
         self._live_counts: "dict | None" = None
         self.live_result = None
+        # the resolved QueryPlan when the caller asked for
+        # method="auto": pinned at submit time for live queries (the
+        # resident game's meter is available synchronously), at quantum
+        # run time for batch jobs — either way it is journaled and lands
+        # on the terminal service.job event so a replay runs the SAME
+        # concrete method/kwargs
+        self.plan = None
         self.attempts = 0
         self.recovered_values = 0
         self.packed_batches = 0
@@ -892,6 +899,8 @@ class SweepService:
                            profile=profile)
             job._fault_entry = entry
             job._live_query = _live
+            if _live is not None and _live.get("plan") is not None:
+                job.plan = _live["plan"]
             if self._journal is not None:
                 # journal BEFORE registering: an un-journalable
                 # submission must fail synchronously (the caller is owed
@@ -907,7 +916,13 @@ class SweepService:
                     self._journal.append({
                         "type": "submit", "job": job_id, "tenant": tenant,
                         "method": job.method, "priority": int(priority),
-                        "partners_count": int(scenario.partners_count)})
+                        "partners_count": int(scenario.partners_count),
+                        # a submit-time plan (live method="auto") rides
+                        # the submit record: replay re-runs the SAME
+                        # concrete method, never a re-plan under
+                        # different meter state
+                        **({"plan": job.plan.describe()}
+                           if job.plan is not None else {})})
                 except OSError as e:
                     raise ServiceError(
                         f"could not journal submission {job_id!r}: "
@@ -916,7 +931,10 @@ class SweepService:
             obs_metrics.counter("service.jobs_accepted").inc()
             obs_trace.event("service.submit", tenant=tenant, job=job_id,
                             method=job.method, ordinal=ordinal,
-                            priority=int(priority))
+                            priority=int(priority),
+                            **({"planned": job.plan.method,
+                                "plan_reason": job.plan.reason}
+                               if job.plan is not None else {}))
             self._queue.push(job)
             self._lock.notify_all()
         # the accepted submission moved the queue depth: let the fleet's
@@ -971,6 +989,7 @@ class SweepService:
                     job_id: "str | None" = None,
                     priority: "int | None" = None,
                     prune: "float | None" = None,
+                    accuracy_target: "float | None" = None,
                     **method_kw) -> SweepJob:
         """Submit a low-latency live contributivity query against the
         tenant's resident game. Rides the EXISTING admission/priority/
@@ -980,14 +999,42 @@ class SweepService:
         queries are the latency-sensitive traffic the governor protects)
         with `MPLC_TPU_LIVE_QUERY_DEADLINE_SEC` as the default deadline
         (0/unset = none; an explicit `deadline_sec` wins). `method` is
-        "exact" | "GTG-Shapley" | "SVARM"; `prune` is the DPVS threshold
-        tau (None = the env default). The answer is `job.result()` (the
-        scores) with the full `LiveQueryResult` on `job.live_result`."""
+        "exact" | "GTG-Shapley" | "SVARM" | "auto"; `prune` is the DPVS
+        threshold tau (None = the env default). The answer is
+        `job.result()` (the scores) with the full `LiveQueryResult` on
+        `job.live_result`.
+
+        `method="auto"` resolves HERE, synchronously: the adaptive
+        planner (contrib/planner.py) routes
+        `(partners, accuracy_target, deadline_sec)` to a concrete
+        estimator using the resident game's measured per-eval cost, the
+        resolved QueryPlan is pinned into the live spec AND the journal's
+        submit record (a replay runs the same concrete query, never a
+        re-plan), and the plan's prune tau wins when the caller passed
+        none — even tau=0 (unpruned) is the plan's decision."""
         game = self._live_games.get(tenant)
         if game is None:
             raise ServiceError(
                 f"no live game for tenant {tenant!r} — call live_game() "
                 "first")
+        # the planner must see the EFFECTIVE deadline, so the env
+        # default resolves before the auto branch (explicit wins, as
+        # documented)
+        if deadline_sec is None and self._live_deadline > 0:
+            deadline_sec = self._live_deadline
+        plan = None
+        if method == "auto":
+            from ..contrib.planner import (estimate_eval_seconds,
+                                           plan_query)
+            eval_sec, basis = estimate_eval_seconds(game.engine)
+            plan = plan_query(game.engine.partners_count,
+                              accuracy_target, deadline_sec,
+                              eval_sec=eval_sec, cost_basis=basis,
+                              live=True)
+            method = plan.method
+            if prune is None:
+                prune = plan.prune_tau
+            method_kw = {**plan.method_kw, **method_kw}
         # validate what the quantum would deterministically reject
         # SYNCHRONOUSLY (same rule as submit()'s method check): a job
         # that can only ever ValueError must not burn the retry budget,
@@ -1004,13 +1051,12 @@ class SweepService:
                 f"prune tau must be in [0, 1], got {prune}")
         if priority is None:
             priority = self._priority_default + 1
-        if deadline_sec is None and self._live_deadline > 0:
-            deadline_sec = self._live_deadline
         return self.submit(game.scenario, tenant=tenant,
                            deadline_sec=deadline_sec, job_id=job_id,
                            priority=priority,
                            _live={"game": game, "method": method,
-                                  "prune": prune, "kw": method_kw})
+                                  "prune": prune, "kw": method_kw,
+                                  "plan": plan})
 
     # -- scheduling loop -------------------------------------------------
 
@@ -1699,6 +1745,11 @@ class SweepService:
             job.values = dict(game._evaluator().values)
         job.scores = np.asarray(result.scores)
         job.live_result = result
+        # a submit-time plan (method="auto") rides the result handle:
+        # the game saw only the concrete method, so the plan attaches
+        # here for `job.live_result.describe()` consumers
+        if spec.get("plan") is not None and result.plan is None:
+            result.plan = spec["plan"]
         # stream the answer as one terminal item so stream() consumers
         # (and the ttfv SLO histogram) see live answers like batch values
         job._push_stream([(("live", spec["method"]),
@@ -1737,7 +1788,19 @@ class SweepService:
             job.tenant, eng, eng.sweep_plan(job.subsets))
         job.scenario._charac_engine = eng
         contrib = Contributivity(job.scenario)
-        contrib.compute_contributivity(job.method)
+        # method="auto" resolves at run time (the engine's meter/bank
+        # cost truth exists only once the job's engine is built); the
+        # job's deadline is the planner's budget. The resolved plan is
+        # journaled so the WAL replay knows the concrete query, and
+        # lands on the terminal service.job event.
+        contrib.compute_contributivity(job.method,
+                                       deadline_sec=job.deadline_sec)
+        plan = getattr(contrib, "plan", None)
+        if plan is not None:
+            job.plan = plan
+            self._journal_safe({"type": "plan", "job": job.job_id,
+                                "tenant": job.tenant,
+                                "plan": plan.describe()})
         self._journal_new_values(job)
         job.scores = np.asarray(contrib.contributivity_scores)
         return True
@@ -1815,6 +1878,10 @@ class SweepService:
             device_basis=job.device_basis,
             **({"profile_path": job.profile_path}
                if job.profile_path else {}),
+            **({"planned": job.plan.method,
+                "plan_reason": job.plan.reason,
+                "plan": job.plan.describe()}
+               if job.plan is not None else {}),
             **job._slo_attrs())
         self._release_engine_data(job)
         self._retire(job)
@@ -1852,6 +1919,9 @@ class SweepService:
             device_basis=job.device_basis,
             **({"profile_path": job.profile_path}
                if job.profile_path else {}),
+            **({"planned": job.plan.method,
+                "plan_reason": job.plan.reason}
+               if job.plan is not None else {}),
             error=str(err)[:200], **job._slo_attrs())
         self._retire(job)
         job._finish()

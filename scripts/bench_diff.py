@@ -36,6 +36,15 @@ bits — same-fingerprint runs whose values drifted fail regardless of
 the perf threshold (`numerics.max_ulp` / `numerics.p99_ulp` /
 `numerics.rank_tau` rows); pre-numerics sidecars skip the gate
 silently, fingerprint mismatches are noted and never gated.
+
+Precision gate: a sidecar carrying a `precision` block (a bf16/mixed
+run's ledger diff against its own fp32 reference twin) gates on the
+pair's Kendall tau-b with a HARD floor (`--tau-threshold`, default
+0.99; exactly 1.0 when the block claims mode fp32) — cross-precision
+sidecar pairs have different engine fingerprints BY DESIGN (precision
+is fingerprinted), so this block is their value truth and satisfies
+`--gate` where the numerics gate cannot run. The live bench's
+`recon.kernel_query_s` row tracks the fused-kernel fresh-query latency.
 """
 
 from __future__ import annotations
@@ -71,7 +80,19 @@ _ROWS = {
     # path while quietly growing a straggler; these rows catch that
     "fleet.straggler_ratio": "lower",
     "fleet.coalitions_per_shard_s": "higher",
+    # raw-speed plane rows: the live bench's fresh-query latency under
+    # the resolved reconstruction executable (config 8 sidecar `recon`
+    # block), and the mixed-precision run's fp32-reference wall-clock
+    # (the speedup's denominator — it shrinking means the REFERENCE got
+    # faster, which is fine, hence "lower")
+    "recon.kernel_query_s": "lower",
+    "precision.fp32_reference_s": "lower",
 }
+
+#: a non-fp32 run's Kendall tau-b against its own fp32 reference twin
+#: below this is a HARD regression (rank agreement is the contract that
+#: licenses the speed mode) — override with --tau-threshold
+TAU_B_THRESHOLD = 0.99
 
 
 def _get_path(doc: dict, path: str):
@@ -211,7 +232,51 @@ def _numerics_rows(old: dict, new: dict, notes: list):
     return rows
 
 
-def diff_sidecars(old: dict, new: dict, threshold: float) -> dict:
+def _precision_rows(old: dict, new: dict, notes: list,
+                    tau_threshold: float = TAU_B_THRESHOLD):
+    """The mixed-precision gate: a sidecar carrying a `precision` block
+    (bench.py `_note_precision` — a non-fp32 run's ledger diff against
+    its own fp32 reference twin) gates on the pair's Kendall tau-b. The
+    threshold is HARD (correctness, not a perf delta): a new-side tau-b
+    below `tau_threshold` regresses regardless of the perf threshold,
+    and any tau-b below 1.0 while the block claims mode fp32 is always
+    a regression (an fp32 run must rank-agree with its fp32 twin
+    exactly). The old side's tau-b, when present, is the displayed
+    baseline; absent (e.g. an fp32 baseline sidecar, which has no
+    block) it defaults to the contract value 1.0."""
+    pn = new.get("precision")
+    if not isinstance(pn, dict) or pn.get("tau_b") is None:
+        return []
+    po = old.get("precision")
+    tau = float(pn["tau_b"])
+    baseline = (float(po["tau_b"])
+                if isinstance(po, dict) and po.get("tau_b") is not None
+                else 1.0)
+    hard_fp32 = str(pn.get("mode", "")) == "fp32" and tau < 1.0
+    regressed = hard_fp32 or tau < tau_threshold
+    rows = [{"row": "precision.tau_b", "old": baseline, "new": tau,
+             "delta_frac": tau - baseline, "direction": "higher",
+             "regressed": regressed}]
+    ulp = pn.get("ulp") or {}
+    if ulp.get("max") is not None:
+        # informational, never gated: the bf16 ulp spread is the
+        # documented deviation the tau gate licenses
+        notes.append(f"precision: mode={pn.get('mode')} ledger pair ulp "
+                     f"max={ulp.get('max')} p99={ulp.get('p99')} over "
+                     f"{pn.get('common')} subsets")
+    if regressed:
+        notes.append(
+            "precision: tau_b DROPPED below the hard gate ("
+            + (f"fp32 pair must be exactly 1.0, got {tau:.4f}"
+               if hard_fp32 else
+               f"{tau:.4f} < {tau_threshold}") + ") — the "
+            f"{pn.get('mode')} speed mode lost rank agreement with its "
+            "fp32 reference")
+    return rows
+
+
+def diff_sidecars(old: dict, new: dict, threshold: float,
+                  tau_threshold: float = TAU_B_THRESHOLD) -> dict:
     """Compare two sidecar documents. Returns
     {rows: [...], regressions: [...], notes: [...], comparable: bool}.
 
@@ -252,6 +317,13 @@ def diff_sidecars(old: dict, new: dict, threshold: float) -> dict:
     # carry the block AND the provenance comparison holds
     for row in _numerics_rows(old, new, notes):
         row["regressed"] = row["regressed"] and comparable
+        out_rows.append(row)
+        if row["regressed"]:
+            regressions.append(row)
+    # the precision tau-b gate is INTRA-sidecar truth (the new run vs
+    # its own fp32 reference twin), so it gates even across provenance-
+    # incomparable pairs — rank agreement is not a scale question
+    for row in _precision_rows(old, new, notes, tau_threshold):
         out_rows.append(row)
         if row["regressed"]:
             regressions.append(row)
@@ -313,14 +385,23 @@ def main(argv=None) -> int:
     ap.add_argument("new", help="candidate sidecar .json (or directory)")
     ap.add_argument("--threshold", type=float, default=0.10,
                     help="fractional regression gate (default 0.10)")
+    ap.add_argument("--tau-threshold", type=float, default=TAU_B_THRESHOLD,
+                    help="hard floor for a precision ledger-pair's Kendall "
+                         f"tau-b (default {TAU_B_THRESHOLD}; an fp32 "
+                         "pair's floor is always exactly 1.0)")
     ap.add_argument("--gate", action="store_true",
                     help="strict CI mode: exit 2 unless the diff actually "
                          "compared rows between provenance-comparable "
-                         "sidecars AND the numerics value-truth gate ran "
-                         "(both sides carried a same-fingerprint numerics "
-                         "block) — a gate that compared nothing, or that "
-                         "silently skipped the value bits, must not read "
-                         "green")
+                         "sidecars AND a value-truth gate ran — either "
+                         "the numerics gate (both sides carried a "
+                         "same-fingerprint numerics block) or the "
+                         "precision tau-b gate (the new side carried a "
+                         "ledger-pair block). Cross-precision pairs have "
+                         "DIFFERENT fingerprints by design (precision is "
+                         "part of the engine fingerprint), so the "
+                         "precision gate is their value truth. A gate "
+                         "that compared nothing, or that silently "
+                         "skipped the value bits, must not read green")
     args = ap.parse_args(argv)
 
     try:
@@ -339,16 +420,20 @@ def main(argv=None) -> int:
         regressed = False
         compared_total = 0
         numerics_rows = 0
+        precision_rows = 0
         incomparable = 0
         for label, p_old, p_new in jobs:
             result = diff_sidecars(_load(p_old), _load(p_new),
-                                   args.threshold)
+                                   args.threshold,
+                                   tau_threshold=args.tau_threshold)
             print(format_diff(result, label or os.path.basename(p_new),
                               args.threshold))
             regressed = regressed or bool(result["regressions"])
             compared_total += result.get("compared_rows", 0)
             numerics_rows += sum(1 for r in result["rows"]
                                  if r["row"].startswith("numerics."))
+            precision_rows += sum(1 for r in result["rows"]
+                                  if r["row"].startswith("precision.tau"))
             incomparable += 0 if result["comparable"] else 1
         if args.gate:
             problems = []
@@ -357,9 +442,11 @@ def main(argv=None) -> int:
             if incomparable:
                 problems.append(f"{incomparable} pair(s) provenance-"
                                 "incomparable (deltas not gated)")
-            if not numerics_rows:
-                problems.append("the numerics value-truth gate never ran "
-                                "(no same-fingerprint numerics blocks)")
+            if not numerics_rows and not precision_rows:
+                problems.append("the value-truth gate never ran "
+                                "(neither a same-fingerprint numerics "
+                                "block pair nor a precision ledger-pair "
+                                "block)")
             if problems:
                 print("[bench_diff] --gate error: "
                       + "; ".join(problems), file=sys.stderr)

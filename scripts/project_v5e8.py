@@ -202,6 +202,30 @@ def load_telemetry_live(path):
     return _telemetry_row(path, "live")
 
 
+def _telemetry_block(path, key):
+    """A TOP-LEVEL sidecar block (alongside `numerics`/`fleet`) — unlike
+    `_telemetry_row`, not nested under `report`. Absent blocks (older
+    sidecars, fp32/scan runs that produce none) load as {}."""
+    import json
+    with open(path) as f:
+        rec = json.load(f)
+    return dict(rec.get(key) or {})
+
+
+def load_telemetry_precision(path):
+    """The mixed-precision block (non-fp32 runs, ISSUE 17): the fp32
+    reference twin's executed seconds + the ledger-pair tau-b/ulp
+    evidence that licenses the speed mode. fp32 runs load as {}."""
+    return _telemetry_block(path, "precision")
+
+
+def load_telemetry_recon(path):
+    """The reconstruction-kernel block (BENCH_CONFIG=8): the resolved
+    scan-vs-kernel path and the fresh-query latency bench_diff gates as
+    `recon.kernel_query_s`. Pre-kernel sidecars load as {}."""
+    return _telemetry_block(path, "recon")
+
+
 def load_measured_fleet(path):
     """The measured fleet-scaling sidecar (BENCH_CONFIG=9,
     perf/telemetry_config9.json): {} when the sidecar is absent, invalid
@@ -563,6 +587,36 @@ def main():
                   + (f"{p50:.3f}s" if p50 is not None else "n/a")
                   + " — latency-vs-rounds table in the sidecar's "
                     "latency_vs_rounds block")
+        pr = load_telemetry_precision(args.telemetry)
+        if pr.get("mode"):
+            # non-fp32 runs: the speedup this sidecar's batch times embody
+            # is only admissible with this block's rank agreement — a
+            # projection from a tau-degraded run projects a run bench_diff
+            # would refuse
+            ulp = pr.get("ulp") or {}
+            tau = pr.get("tau_b")
+            ref = pr.get("fp32_reference_s")
+            print(f"measured precision: mode={pr['mode']} tau_b="
+                  + (f"{tau:.4f}" if tau is not None else "n/a")
+                  + " fp32_reference="
+                  + (f"{ref:.1f}s" if ref is not None else "n/a")
+                  + f" ulp_max={ulp.get('max')} p99={ulp.get('p99')} over "
+                  f"{pr.get('common', '?')} subsets — batch times below "
+                  "are the speed mode's; the fp32 twin's executed "
+                  "seconds are the like-for-like baseline")
+        rk = load_telemetry_recon(args.telemetry)
+        if rk.get("kernel_mode"):
+            path_txt = ("fused-kernel"
+                        + (" (interpret)" if rk.get("interpret") else "")
+                        if rk.get("use_kernel") else "scan")
+            kq = rk.get("kernel_query_s")
+            print(f"measured recon path: {path_txt} "
+                  f"(MPLC_TPU_RECON_KERNEL={rk['kernel_mode']}, "
+                  f"precision={rk.get('precision', 'fp32')}) fresh-query="
+                  + (f"{kq:.3f}s" if kq is not None else "n/a")
+                  + " — the bench_diff recon.kernel_query_s row; scan "
+                  "fallback means this sidecar measured the reference "
+                  "path, not the kernel")
         t = load_telemetry_trust(args.telemetry)
         if t.get("ensemble"):
             # the sweep's answer-trust view (absent in single-seed,

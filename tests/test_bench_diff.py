@@ -185,3 +185,70 @@ def test_gate_mode_refuses_provenance_incomparable_pairs(tmp_path, capsys):
     assert bench_diff.main([str(a), str(b)]) == 0
     assert bench_diff.main([str(a), str(b), "--gate"]) == 2
     assert "incomparable" in capsys.readouterr().err
+
+
+def _with_precision(doc, tau, mode="bf16", with_ulp=True):
+    doc = copy.deepcopy(doc)
+    doc["precision"] = {"mode": mode, "tau_b": tau,
+                        "fp32_reference_s": 2.0, "common": 15,
+                        "drift": tau < 1.0}
+    if with_ulp:
+        doc["precision"]["ulp"] = {"max": 9e12, "p50": 0, "p99": 3e11,
+                                   "nonzero": 3}
+    return doc
+
+
+def test_precision_tau_gate_passes_at_contract_value(tmp_path):
+    """A bf16 sidecar whose ledger pair rank-agrees exactly satisfies
+    --gate even without a same-fingerprint numerics block (the
+    cross-precision pair's truth is INTRA-sidecar)."""
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(_with_precision(_sidecar(), 1.0)))
+    b.write_text(json.dumps(_with_precision(_sidecar(), 1.0)))
+    assert bench_diff.main([str(a), str(b), "--gate"]) == 0
+
+
+def test_precision_tau_below_threshold_is_a_hard_regression():
+    old = _with_precision(_sidecar(), 1.0)
+    new = _with_precision(_sidecar(), 0.95)      # < 0.99 default floor
+    result = bench_diff.diff_sidecars(old, new, 0.10)
+    rows = {r["row"]: r for r in result["regressions"]}
+    assert "precision.tau_b" in rows
+    assert any("lost rank agreement" in n for n in result["notes"])
+    # the floor is tunable: an explicitly looser gate admits the pair
+    loose = bench_diff.diff_sidecars(old, new, 0.10, tau_threshold=0.9)
+    assert not any(r["row"] == "precision.tau_b"
+                   for r in loose["regressions"])
+
+
+def test_fp32_pair_must_rank_agree_exactly():
+    """mode=fp32 claiming tau < 1.0 regresses regardless of threshold:
+    an fp32 run that disagrees with its fp32 twin is broken, not slow."""
+    new = _with_precision(_sidecar(), 0.9999, mode="fp32")
+    result = bench_diff.diff_sidecars(_sidecar(), new, 0.10,
+                                      tau_threshold=0.5)
+    assert any(r["row"] == "precision.tau_b"
+               for r in result["regressions"])
+
+
+def test_precision_baseline_defaults_to_contract_value():
+    # an fp32 baseline sidecar has no precision block: displayed
+    # baseline is the contract value 1.0, and the ulp spread is an
+    # informational note, never a gated row
+    result = bench_diff.diff_sidecars(
+        _sidecar(), _with_precision(_sidecar(), 1.0), 0.10)
+    row = [r for r in result["rows"] if r["row"] == "precision.tau_b"][0]
+    assert row["old"] == 1.0 and not row["regressed"]
+    assert any("ulp" in n for n in result["notes"])
+
+
+def test_recon_kernel_query_latency_is_direction_aware():
+    old, new = copy.deepcopy(_sidecar()), copy.deepcopy(_sidecar())
+    old["recon"] = {"kernel_query_s": 0.10}
+    new["recon"] = {"kernel_query_s": 0.20}     # 2x slower fresh query
+    result = bench_diff.diff_sidecars(old, new, 0.10)
+    assert any(r["row"] == "recon.kernel_query_s"
+               for r in result["regressions"])
+    faster = copy.deepcopy(old)
+    faster["recon"]["kernel_query_s"] = 0.05
+    assert not bench_diff.diff_sidecars(old, faster, 0.10)["regressions"]

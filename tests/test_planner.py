@@ -1,0 +1,274 @@
+"""Adaptive query planner: the method="auto" routing table + journaling.
+
+The contract under test (contrib/planner.py + the "auto" dispatch in
+contrib/contributivity.py, live/game.py and service/scheduler.py):
+
+1. **Routing table.** `plan_query` routes `(partners, accuracy_target,
+   deadline_sec)` deterministically: exact while the 2^P - 1 sweep fits,
+   GTG-Shapley when the game outgrows the exact table or the deadline
+   excludes it, SVARM (budget clamped to the deadline) as deadlines
+   tighten, DPVS-pruned GTG (live) / floor-budget SVARM (batch) below
+   every estimator's floor. Every plan carries its reason and cost
+   evidence.
+2. **Replayability.** A plan resolves from its inputs alone (measured
+   eval_sec is an INPUT, passed by the caller): the same triple yields
+   an identical plan, `plan_from_dict(plan.describe())` round-trips, and
+   re-running the journaled concrete method reproduces the auto query's
+   scores bit-identically.
+3. **Journaled dispatch.** `compute_contributivity("auto")` emits a
+   `contrib.plan` event, stashes the plan on the Contributivity object
+   and dispatches the CONCRETE method; `LiveGame.query(method="auto")`
+   emits `live.plan` and returns the plan on the result; the sweep
+   service's `submit_live(method="auto")` pins the plan into the WAL's
+   submit record and the terminal `service.job` event.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from helpers import build_scenario, cluster_mlp_dataset
+from mplc_tpu.contrib import planner
+from mplc_tpu.contrib.contributivity import Contributivity
+from mplc_tpu.contrib.planner import (QueryPlan, plan_from_dict,
+                                      plan_query)
+from mplc_tpu.obs import trace as obs_trace
+
+from test_contrib import PHI3, additive, fake_scenario
+from test_reconstruct import _StubRecon
+
+
+# ---------------------------------------------------------------------------
+# 1. the routing table (pure plan_query)
+# ---------------------------------------------------------------------------
+
+def test_exact_under_16_partners_with_loose_deadline():
+    for n in (1, 2, 4, 8, 16):
+        p = plan_query(n)
+        assert p.method == "exact"
+        assert p.est_evals == 2 ** n - 1
+        assert p.prune_tau == 0.0
+        assert "exact" in p.reason
+
+
+def test_exact_when_sweep_fits_the_deadline():
+    # 2^4 - 1 = 15 evals at 0.1 s each = 1.5 s <= 2 s
+    p = plan_query(4, deadline_sec=2.0, eval_sec=0.1, cost_basis="meter")
+    assert p.method == "exact"
+    assert p.cost_basis == "meter"
+    assert p.est_cost_sec == pytest.approx(1.5)
+
+
+def test_gtg_when_game_outgrows_the_exact_table():
+    p = plan_query(24)
+    assert p.method == "GTG-Shapley"
+    assert "P=24" in p.reason
+    assert p.method_kw == {"sv_accuracy": p.accuracy_target}
+
+
+def test_gtg_when_deadline_excludes_exact():
+    # exact = 2^10 - 1 = 1023 evals > 500; GTG = 100 * 10 = 1000... also
+    # over, so pick a deadline between the two budgets
+    p = plan_query(10, deadline_sec=1001 * 0.05, eval_sec=0.05,
+                   cost_basis="meter")
+    assert p.method == "GTG-Shapley"
+    assert "deadline" in p.reason
+
+
+def test_accuracy_target_reaches_gtg_stopping_rule():
+    p = plan_query(24, accuracy_target=0.005)
+    assert p.method_kw == {"sv_accuracy": 0.005}
+    assert p.accuracy_target == 0.005
+
+
+def test_svarm_as_the_deadline_tightens_clamps_budget():
+    # GTG needs 100 * 20 = 2000 evals; SVARM's floor for n=20 is
+    # 2n + (n^2 - 2n) + 128 = 528 — a deadline affording 600 evals
+    # routes SVARM with the budget clamped to what remains after the
+    # anchor/warm-up overhead
+    n, eval_sec = 20, 0.05
+    p = plan_query(n, deadline_sec=600 * eval_sec, eval_sec=eval_sec,
+                   cost_basis="meter")
+    assert p.method == "SVARM"
+    budget = p.method_kw["budget"]
+    overhead = 2 * n + (n * n - 2 * n)
+    assert budget == 600 - overhead
+    assert budget >= 128
+    assert budget <= max(4 * n * n, 128)
+
+
+def test_pruned_rung_live_vs_floor_svarm_batch(monkeypatch):
+    monkeypatch.delenv("MPLC_TPU_LIVE_PRUNE_TAU", raising=False)
+    # a deadline below even SVARM's floor (n=20 floor = 528 evals)
+    n, eval_sec = 20, 0.05
+    live = plan_query(n, deadline_sec=10 * eval_sec, eval_sec=eval_sec,
+                      cost_basis="meter", live=True)
+    assert live.method == "GTG-Shapley"
+    assert live.prune_tau == pytest.approx(0.5)
+    assert "DPVS" in live.reason
+    batch = plan_query(n, deadline_sec=10 * eval_sec, eval_sec=eval_sec,
+                       cost_basis="meter", live=False)
+    assert batch.method == "SVARM"
+    assert batch.method_kw["budget"] == 128
+    assert batch.prune_tau == 0.0
+    assert "best-effort" in batch.reason
+
+
+def test_pruned_rung_honors_env_tau(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_LIVE_PRUNE_TAU", "0.25")
+    p = plan_query(20, deadline_sec=0.1, eval_sec=0.05,
+                   cost_basis="meter", live=True)
+    assert p.prune_tau == pytest.approx(0.25)
+
+
+def test_planner_env_defaults(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_PLANNER_ACCURACY", "0.004")
+    monkeypatch.setenv("MPLC_TPU_PLANNER_DEADLINE_SEC", "0.2")
+    p = plan_query(20, eval_sec=0.05, cost_basis="meter")
+    assert p.accuracy_target == 0.004
+    assert p.deadline_sec == 0.2
+    monkeypatch.delenv("MPLC_TPU_PLANNER_ACCURACY")
+    monkeypatch.delenv("MPLC_TPU_PLANNER_DEADLINE_SEC")
+    p2 = plan_query(20, eval_sec=0.05, cost_basis="meter")
+    assert p2.accuracy_target == 0.02 and p2.deadline_sec is None
+
+
+def test_plan_query_rejects_bad_partner_count():
+    with pytest.raises(ValueError):
+        plan_query(0)
+
+
+# ---------------------------------------------------------------------------
+# 2. replayability: pure resolution + describe round-trip
+# ---------------------------------------------------------------------------
+
+def test_same_inputs_yield_identical_plan():
+    a = plan_query(12, 0.01, 30.0, eval_sec=0.02, cost_basis="meter")
+    b = plan_query(12, 0.01, 30.0, eval_sec=0.02, cost_basis="meter")
+    assert a == b  # frozen dataclass equality — fully deterministic
+
+
+def test_plan_describe_round_trips_through_json():
+    p = plan_query(20, deadline_sec=5.0, eval_sec=0.05,
+                   cost_basis="bank_cost_model")
+    doc = json.loads(json.dumps(p.describe()))
+    q = plan_from_dict(doc)
+    assert isinstance(q, QueryPlan)
+    assert q == p
+
+
+def test_estimate_eval_seconds_default_without_engine():
+    sec, basis = planner.estimate_eval_seconds(None)
+    assert basis == "default" and sec == planner.DEFAULT_EVAL_SEC
+
+
+# ---------------------------------------------------------------------------
+# 3. journaled dispatch through the three surfaces
+# ---------------------------------------------------------------------------
+
+def _analytic(n, fn):
+    sc = fake_scenario(n, fn)
+    sc._charac_engine._reconstruction = _StubRecon(fn)
+    return sc
+
+
+def test_compute_contributivity_auto_small_game_is_exact():
+    sc = _analytic(3, additive(PHI3))
+    c = Contributivity(sc)
+    with obs_trace.collect() as records:
+        c.compute_contributivity("auto")
+    assert c.plan is not None and c.plan.method == "exact"
+    np.testing.assert_allclose(c.contributivity_scores, PHI3, atol=1e-9)
+    # zero sampling error: the exact rung's trust contract by construction
+    np.testing.assert_allclose(c.scores_std, 0.0)
+    events = [r for r in records if r["name"] == "contrib.plan"]
+    assert len(events) == 1
+    attrs = events[0]["attrs"]
+    assert attrs["method"] == "exact" and attrs["partners"] == 3
+    # the journaled event alone rebuilds the concrete plan
+    assert plan_from_dict(attrs) == c.plan
+
+
+def test_compute_contributivity_auto_large_game_samples():
+    phi = [0.01 * (i + 1) for i in range(20)]
+    sc = _analytic(20, additive(phi))
+    c = Contributivity(sc)
+    c.compute_contributivity("auto")
+    assert c.plan.method == "GTG-Shapley"
+    # additive game: GTG's sampled estimate lands near the true values
+    np.testing.assert_allclose(c.contributivity_scores, phi, atol=0.01)
+
+
+def _scenario_3p(seed=3):
+    return build_scenario(
+        partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+        dataset=cluster_mlp_dataset(n=240, seed=9, scale=1.0),
+        epoch_count=2, minibatch_count=2, seed=seed)
+
+
+@pytest.fixture(scope="module")
+def auto_game():
+    from mplc_tpu.live import LiveGame
+    return LiveGame(_scenario_3p())
+
+
+def test_live_auto_query_returns_plan_and_replays(auto_game):
+    game = auto_game
+    with obs_trace.collect() as records:
+        r = game.query(method="auto")
+    assert r.plan is not None and r.plan.method == "exact"
+    assert r.method == "exact"
+    events = [x for x in records if x["name"] == "live.plan"]
+    assert len(events) == 1 and events[0]["attrs"]["method"] == "exact"
+    # replay: running the journaled concrete query reproduces the auto
+    # answer bit-identically (same method + tau + kwargs => memo hit)
+    r2 = game.query(method=r.plan.method, prune=r.plan.prune_tau,
+                    **r.plan.method_kw)
+    np.testing.assert_array_equal(np.asarray(r.scores),
+                                  np.asarray(r2.scores))
+    assert r.plan.describe() in [r.describe().get("plan"),
+                                 r.describe()["plan"]]
+
+
+def test_live_auto_tight_deadline_routes_pruned(auto_game):
+    # deadline below every unpruned floor: the live rung prunes
+    r = auto_game.query(method="auto", deadline_sec=1e-6)
+    assert r.plan is not None
+    assert r.plan.method == "GTG-Shapley" and r.plan.prune_tau > 0
+    assert r.prune_tau == pytest.approx(r.plan.prune_tau)
+
+
+def test_service_submit_live_auto_pins_plan_in_wal(tmp_path):
+    from mplc_tpu.service import SweepService
+    wal = str(tmp_path / "wal.jsonl")
+    svc = SweepService(journal_path=wal)
+    try:
+        game = svc.live_game(_scenario_3p(), tenant="t0")
+        with obs_trace.collect() as records:
+            job = svc.submit_live("t0", method="auto")
+            scores = job.result(timeout=600)
+    finally:
+        svc.shutdown(drain=False)
+    assert job.plan is not None and job.plan.method == "exact"
+    assert job.method == "live:exact"  # the CONCRETE method was queued
+    assert job.live_result.plan == job.plan
+    assert scores is not None and len(scores) == 3
+    # WAL: the submit record carries the resolved plan verbatim
+    # (journal lines wrap each record as {"sha256": ..., "rec": {...}})
+    with open(wal) as f:
+        recs = [json.loads(line)["rec"] for line in f if line.strip()]
+    sub = [r for r in recs if r.get("type") == "submit"]
+    assert len(sub) == 1 and sub[0]["plan"]["method"] == "exact"
+    assert plan_from_dict(sub[0]["plan"]) == job.plan
+    # the terminal service.job event surfaces the plan
+    terminals = [r["attrs"] for r in records
+                 if r["name"] == "service.job"]
+    assert len(terminals) == 1
+    assert terminals[0]["planned"] == "exact"
+    assert plan_from_dict(terminals[0]["plan"]) == job.plan
+
+
+def test_auto_is_a_registered_method():
+    from mplc_tpu import constants
+    assert "auto" in constants.CONTRIBUTIVITY_METHODS

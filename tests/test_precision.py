@@ -1,0 +1,241 @@
+"""Mixed-precision modes: equality where promised, bounds where traded.
+
+The contract under test (MPLC_TPU_PRECISION / TrainConfig.precision +
+the fingerprint/ledger/memo keying that licenses the deviation):
+
+1. **Resolution.** `constants.precision_mode()` resolves the env knob
+   with the standard warn+fallback contract; `TrainConfig` freezes the
+   resolved mode at construction and rejects invalid values; `cfg.dtype`
+   routes mixed/bf16 compute to bfloat16.
+2. **fp32 is not a deviation.** `MPLC_TPU_PRECISION=fp32` (explicit)
+   computes BIT-identical characteristic values to the default
+   (knob-unset) build — same fingerprint, same game.
+3. **bf16 is a LICENSED deviation.** On the fixed-seed 4-partner game,
+   bf16 v(S) stays within an absolute bound of the fp32 reference and
+   the ledger diff's Kendall tau-b ranking agreement is exactly 1.0 —
+   the same pair the bench sidecar embeds and `bench_diff --gate`
+   enforces. The engine fingerprints differ (different game on disk).
+4. **Stale caches refuse.** A cache saved under fp32 raises ValueError
+   when loaded into a bf16 engine (and vice versa); a legacy cache with
+   no precision field backfills to fp32 and loads into an fp32 engine.
+5. **The live memo is precision-keyed** (ISSUE 17's small fix): every
+   memoized live result carries the engine's precision in its key.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from helpers import build_scenario, cluster_mlp_dataset
+from mplc_tpu import constants
+from mplc_tpu.contrib.contributivity import Contributivity
+from mplc_tpu.mpl.engine import TrainConfig
+from mplc_tpu.obs import numerics as obs_num
+
+
+# ---------------------------------------------------------------------------
+# 1. resolution
+# ---------------------------------------------------------------------------
+
+def test_precision_mode_env_resolution(monkeypatch):
+    monkeypatch.delenv("MPLC_TPU_PRECISION", raising=False)
+    assert constants.precision_mode() == "fp32"
+    for mode in ("fp32", "mixed", "bf16"):
+        monkeypatch.setenv("MPLC_TPU_PRECISION", mode)
+        assert constants.precision_mode() == mode
+    monkeypatch.setenv("MPLC_TPU_PRECISION", "fp64")
+    with pytest.warns(UserWarning):
+        assert constants.precision_mode() == "fp32"
+
+
+def test_train_config_freezes_and_validates(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_PRECISION", "mixed")
+    cfg = TrainConfig()
+    assert cfg.precision == "mixed"
+    # frozen at construction: a later env flip does not move the config
+    monkeypatch.setenv("MPLC_TPU_PRECISION", "fp32")
+    assert cfg.precision == "mixed"
+    with pytest.raises(ValueError, match="precision"):
+        TrainConfig(precision="fp64")
+
+
+def test_dtype_routes_compute():
+    assert TrainConfig(precision="fp32").dtype == jnp.float32
+    assert TrainConfig(precision="mixed").dtype == jnp.bfloat16
+    assert TrainConfig(precision="bf16").dtype == jnp.bfloat16
+    # compute_dtype still decides under fp32, as it always has
+    assert TrainConfig(precision="fp32",
+                       compute_dtype="bfloat16").dtype == jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# 2/3. the fixed-seed 4-partner pin: fp32 equality, bf16 bound + tau-b
+# ---------------------------------------------------------------------------
+
+def _scenario_4p():
+    """The strict-quality-ordering 4-partner game (one fully corrupted
+    partner + graded amounts), small enough to retrain 15 coalitions."""
+    return build_scenario(
+        partners_count=4, amounts_per_partner=[0.05, 0.12, 0.28, 0.55],
+        dataset=cluster_mlp_dataset(n=360, seed=11, scale=1.0),
+        epoch_count=2, minibatch_count=2,
+        samples_split_option=["basic", "random"],
+        corrupted_datasets=[("glabel", 1.0), "not_corrupted",
+                            "not_corrupted", "not_corrupted"])
+
+
+def _exact_game(monkeypatch, mode):
+    if mode is None:
+        monkeypatch.delenv("MPLC_TPU_PRECISION", raising=False)
+    else:
+        monkeypatch.setenv("MPLC_TPU_PRECISION", mode)
+    sc = _scenario_4p()
+    Contributivity(sc).compute_SV()
+    eng = sc._charac_engine
+    return eng._fingerprint(), dict(eng.charac_fct_values)
+
+
+def _ledger(fingerprint, values, mode):
+    led = obs_num.ValueLedger(
+        json.dumps(fingerprint, sort_keys=True),
+        meta={"precision": mode})
+    for subset, v in values.items():
+        if subset:
+            led.record(subset, v, source="exact")
+    return led
+
+
+@pytest.fixture(scope="module")
+def fp32_game():
+    mp = pytest.MonkeyPatch()
+    try:
+        yield _exact_game(mp, "fp32")
+    finally:
+        mp.undo()
+
+
+def test_explicit_fp32_is_bit_identical_to_default(monkeypatch, fp32_game):
+    fp_explicit, vals_explicit = fp32_game
+    fp_default, vals_default = _exact_game(monkeypatch, None)
+    assert fp_explicit == fp_default           # same game, same identity
+    assert fp_explicit["precision"] == "fp32"
+    assert vals_explicit.keys() == vals_default.keys()
+    for subset, v in vals_default.items():
+        assert vals_explicit[subset] == v      # BIT-identical, no tolerance
+
+
+def test_bf16_is_bounded_and_rank_identical(monkeypatch, fp32_game):
+    fp_ref, vals_ref = fp32_game
+    fp_b16, vals_b16 = _exact_game(monkeypatch, "bf16")
+    # different game on disk: the fingerprint carries the deviation
+    assert fp_b16["precision"] == "bf16" and fp_b16 != fp_ref
+    # value bound: bf16 compute moves the trajectory, but v(S) (a
+    # test-set accuracy, quantized in 1/|test| steps) stays close
+    for subset, v in vals_ref.items():
+        assert abs(vals_b16[subset] - v) < 0.05
+    # the ledger pair — exactly what the bench sidecar embeds — must
+    # rank-agree perfectly: tau-b == 1.0 is the bench_diff hard gate
+    diff = obs_num.diff_ledgers(_ledger(fp_ref, vals_ref, "fp32"),
+                                _ledger(fp_b16, vals_b16, "bf16"))
+    assert diff["common"] == 2 ** 4 - 1
+    assert not diff["same_fingerprint"]        # cross-precision pair
+    assert diff["kendall_tau"] == 1.0
+
+
+def test_bf16_actually_moves_the_training_compute(monkeypatch):
+    """The deviation is real at the compute layer: bf16 changes the
+    recorded per-round update stream materially (it is not an fp32 run
+    wearing a different fingerprint), even when the quantized test-set
+    accuracy absorbs the difference."""
+    import jax
+
+    def deltas(mode):
+        monkeypatch.setenv("MPLC_TPU_PRECISION", mode)
+        sc = build_scenario(
+            partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+            dataset=cluster_mlp_dataset(n=240, seed=9, scale=1.0),
+            epoch_count=2, minibatch_count=2)
+        recon = Contributivity(sc)._reconstructor()
+        return jax.tree_util.tree_leaves(recon.recorded.deltas)
+
+    moved = [float(np.abs(np.asarray(a, np.float32)
+                          - np.asarray(b, np.float32)).max())
+             for a, b in zip(deltas("fp32"), deltas("bf16"))]
+    assert max(moved) > 1e-3
+
+
+# ---------------------------------------------------------------------------
+# 4. stale caches refuse across precision modes
+# ---------------------------------------------------------------------------
+
+def _small_engine(monkeypatch, mode):
+    monkeypatch.setenv("MPLC_TPU_PRECISION", mode)
+    sc = build_scenario(
+        partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+        dataset=cluster_mlp_dataset(n=240, seed=9, scale=1.0),
+        epoch_count=2, minibatch_count=2)
+    return Contributivity(sc).engine  # constructs the engine, trains nothing
+
+
+def test_cache_refuses_across_precision(monkeypatch, tmp_path):
+    path = tmp_path / "cache.json"
+    _small_engine(monkeypatch, "fp32").save_cache(path)
+    with pytest.raises(ValueError, match="precision"):
+        _small_engine(monkeypatch, "bf16").load_cache(path)
+    # and the reverse direction
+    path2 = tmp_path / "cache_b16.json"
+    _small_engine(monkeypatch, "bf16").save_cache(path2)
+    with pytest.raises(ValueError, match="precision"):
+        _small_engine(monkeypatch, "fp32").load_cache(path2)
+
+
+def test_legacy_cache_backfills_fp32(monkeypatch, tmp_path):
+    path = tmp_path / "cache.json"
+    _small_engine(monkeypatch, "fp32").save_cache(path)
+    with open(path) as f:
+        payload = json.load(f)
+    # simulate a pre-precision (and pre-checksum) cache
+    payload.pop("payload_sha256")
+    payload["fingerprint"].pop("precision")
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    import warnings
+    with warnings.catch_warnings():
+        # the once-per-process legacy-cache warning may or may not fire
+        # here depending on suite order — not this test's contract
+        warnings.simplefilter("ignore", DeprecationWarning)
+        _small_engine(monkeypatch, "fp32").load_cache(path)  # backfilled
+    # the same legacy cache refuses a bf16 engine: backfill says fp32
+    with pytest.raises(ValueError, match="precision"):
+        _small_engine(monkeypatch, "bf16").load_cache(path)
+
+
+# ---------------------------------------------------------------------------
+# 5. the live memo is precision-keyed
+# ---------------------------------------------------------------------------
+
+def test_live_memo_key_carries_precision(monkeypatch):
+    from mplc_tpu.live import LiveGame
+    monkeypatch.delenv("MPLC_TPU_PRECISION", raising=False)
+    sc = build_scenario(
+        partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+        dataset=cluster_mlp_dataset(n=240, seed=9, scale=1.0),
+        epoch_count=2, minibatch_count=2)
+    game = LiveGame(sc)
+    game.query(method="exact")
+    keys = list(game._results)
+    assert keys and all(k[2] == "fp32" for k in keys)
+    # a second identical query memo-hits (the key is stable)
+    hits_key = keys[0]
+    assert game._results[hits_key] is game.query(method="exact")
+
+
+def test_engine_ledger_meta_carries_precision(monkeypatch, tmp_path):
+    monkeypatch.setenv("MPLC_TPU_NUMERICS_LEDGER",
+                       str(tmp_path / "ledger.json"))
+    eng = _small_engine(monkeypatch, "bf16")
+    assert eng.numerics_ledger is not None
+    assert eng.numerics_ledger.meta.get("precision") == "bf16"

@@ -333,3 +333,29 @@ def test_projection_precedence_rule_in_main(tmp_path, capsys, monkeypatch):
     out = capsys.readouterr().out
     assert "projected 10-partner sweep" in out    # pins kept for compare
     assert "MEASURED fleet scaling" in out and "SUPERSEDED" in out
+
+
+def test_telemetry_precision_and_recon_blocks_load_and_degrade(tmp_path):
+    """load_telemetry_precision / load_telemetry_recon read the ISSUE-17
+    top-level sidecar blocks; fp32/scan runs and pre-kernel sidecars
+    load as {} — same compat contract as the report rows."""
+    import json
+    new = tmp_path / "telemetry_config8.json"
+    new.write_text(json.dumps({
+        "metric": "m",
+        "report": {"wallclock": {"evaluate_s": 1.0}},
+        "precision": {"mode": "bf16", "tau_b": 1.0,
+                      "fp32_reference_s": 2.5, "common": 15,
+                      "ulp": {"max": 9e12, "p99": 3e11, "nonzero": 3}},
+        "recon": {"kernel_mode": "interpret", "use_kernel": True,
+                  "interpret": True, "precision": "bf16",
+                  "kernel_query_s": 0.123}}))
+    pr = proj.load_telemetry_precision(str(new))
+    assert pr["mode"] == "bf16" and pr["tau_b"] == 1.0
+    rk = proj.load_telemetry_recon(str(new))
+    assert rk["use_kernel"] is True and rk["kernel_query_s"] == 0.123
+    old = tmp_path / "telemetry_old.json"
+    old.write_text(json.dumps({
+        "metric": "m", "report": {"wallclock": {"evaluate_s": 290.0}}}))
+    assert proj.load_telemetry_precision(str(old)) == {}
+    assert proj.load_telemetry_recon(str(old)) == {}

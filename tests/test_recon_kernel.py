@@ -1,0 +1,224 @@
+"""Fused Pallas reconstruction kernel: parity, pass-through, routing.
+
+The contract under test (ops/recon_kernel.py + the evaluator routing in
+contrib/reconstruct.py + the program identity in contrib/bank.py):
+
+1. **Mode resolution.** `resolve(mode)` maps MPLC_TPU_RECON_KERNEL to
+   `(use_kernel, interpret)`: `off` is always the scan, `interpret` runs
+   the kernel through the Pallas interpreter on any backend, `force`
+   demands the compiled kernel (raising when Pallas is absent), `auto`
+   compiles only where `kernel_available()` (TPU) — so CPU tier-1 runs
+   the scan fallback by default.
+2. **Interpret-mode parity everywhere.** `reconstruct_batch` with
+   `interpret=True` matches a NumPy replay of the per-round masked
+   renormalize + accumulate on odd (non-tile-multiple) shapes — the
+   padding lanes contribute exact zeros — and a coalition whose every
+   round has zero surviving weight reproduces `init` BIT-exactly.
+3. **Evaluator routing.** With MPLC_TPU_RECON_KERNEL=interpret the
+   ReconstructionEvaluator's values stay within float-reassociation
+   distance of the scan path, the PR-4 fault ladder holds bit-identically
+   on the kernel path, and the ProgramBank recon key separates
+   kernel/scan and fp32/bf16 executables.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from helpers import build_scenario, cluster_mlp_dataset
+from mplc_tpu.contrib.bank import ProgramBank
+from mplc_tpu.contrib.contributivity import Contributivity
+from mplc_tpu.obs import metrics
+from mplc_tpu.ops import recon_kernel
+
+
+# ---------------------------------------------------------------------------
+# 1. mode resolution
+# ---------------------------------------------------------------------------
+
+def test_resolve_mode_table_on_cpu():
+    assert recon_kernel.resolve("off") == (False, False)
+    assert recon_kernel.resolve("interpret") == (True, True)
+    assert recon_kernel.resolve("force") == (True, False)
+    # auto compiles on TPU only — this suite runs on the CPU tier, so
+    # auto must fall back to the scan reference
+    assert not recon_kernel.kernel_available()
+    assert recon_kernel.resolve("auto") == (False, False)
+
+
+def test_force_without_pallas_raises(monkeypatch):
+    monkeypatch.setattr(recon_kernel, "_PALLAS_OK", False)
+    assert recon_kernel.resolve("auto") == (False, False)
+    assert recon_kernel.resolve("interpret") == (False, False)
+    with pytest.raises(RuntimeError, match="force"):
+        recon_kernel.resolve("force")
+
+
+def test_env_mode_reaches_evaluator_plan(monkeypatch):
+    from mplc_tpu import constants
+    monkeypatch.setenv("MPLC_TPU_RECON_KERNEL", "interpret")
+    assert constants.recon_kernel_mode() == "interpret"
+    monkeypatch.setenv("MPLC_TPU_RECON_KERNEL", "not-a-mode")
+    with pytest.warns(UserWarning):
+        assert constants.recon_kernel_mode() == "auto"
+
+
+# ---------------------------------------------------------------------------
+# 2. interpret-mode parity vs a NumPy replay (odd shapes => padding)
+# ---------------------------------------------------------------------------
+
+def _fixture_game(B=5, R=3, P=4, seed=0):
+    """Odd-shaped random reconstruction inputs: nothing is a multiple of
+    the kernel tiles (B=5, K=R*P=12, D=5*3+7=22), so every padding path
+    (batch rows, K tail, D tail) is exercised."""
+    rng = np.random.default_rng(seed)
+    masks = (rng.random((B, P)) < 0.6).astype(np.float32)
+    masks[0] = 0.0                       # the zero-weight pass-through row
+    masks[1] = 1.0                       # and a grand-coalition row
+    weights = rng.random((R, P)).astype(np.float32)
+    weights[R - 1] = 0.0                 # an early-stopped (all-zero) round
+    init = {"w": rng.standard_normal((5, 3)).astype(np.float32),
+            "b": rng.standard_normal((7,)).astype(np.float32)}
+    deltas = {k: rng.standard_normal((R, P) + v.shape).astype(np.float32)
+              for k, v in init.items()}
+    return masks, init, deltas, weights
+
+
+def _np_reference(masks, init, deltas, weights):
+    ws = weights[None, :, :] * masks[:, None, :]          # [B, R, P]
+    denom = ws.sum(-1, keepdims=True)
+    wn = np.where(denom > 0, ws / np.maximum(denom, 1e-12), 0.0)
+    return {k: init[k][None] + np.einsum("brp,rp...->b...", wn, deltas[k])
+            for k in init}
+
+
+def test_interpret_parity_on_odd_shapes():
+    masks, init, deltas, weights = _fixture_game()
+    ref = _np_reference(masks, init, deltas, weights)
+    out = recon_kernel.reconstruct_batch(
+        jnp.asarray(masks), {k: jnp.asarray(v) for k, v in init.items()},
+        {k: jnp.asarray(v) for k, v in deltas.items()},
+        jnp.asarray(weights), interpret=True)
+    for k in init:
+        got = np.asarray(out[k])
+        assert got.shape == (masks.shape[0],) + init[k].shape
+        assert got.dtype == np.float32
+        np.testing.assert_allclose(got, ref[k], rtol=1e-5, atol=1e-5)
+
+
+def test_zero_weight_coalition_passes_init_through_bit_exactly():
+    masks, init, deltas, weights = _fixture_game()
+    out = recon_kernel.reconstruct_batch(
+        jnp.asarray(masks), {k: jnp.asarray(v) for k, v in init.items()},
+        {k: jnp.asarray(v) for k, v in deltas.items()},
+        jnp.asarray(weights), interpret=True)
+    # row 0's mask is all-zero: every round renormalizes to exact-zero
+    # weights and the matmul contributes exact 0.0 — BIT-equal to init
+    for k in init:
+        np.testing.assert_array_equal(np.asarray(out[k])[0], init[k])
+
+
+def test_normalized_round_weights_contract():
+    masks, _, _, weights = _fixture_game()
+    wn = np.asarray(recon_kernel.normalized_round_weights(
+        jnp.asarray(masks), jnp.asarray(weights)))
+    B, (R, P) = masks.shape[0], weights.shape
+    assert wn.shape == (B, R, P)
+    ws = weights[None] * masks[:, None]
+    denom = ws.sum(-1)
+    np.testing.assert_array_equal(wn[denom == 0], 0.0)    # exact zeros
+    np.testing.assert_allclose(wn.sum(-1)[denom > 0], 1.0, rtol=1e-6)
+
+
+def test_bf16_precision_leaf_dtypes():
+    masks, init, deltas, weights = _fixture_game()
+    out = recon_kernel.reconstruct_batch(
+        jnp.asarray(masks), {k: jnp.asarray(v) for k, v in init.items()},
+        {k: jnp.asarray(v) for k, v in deltas.items()},
+        jnp.asarray(weights), precision="bf16", interpret=True)
+    ref = _np_reference(masks, init, deltas, weights)
+    for k in init:
+        assert out[k].dtype == jnp.bfloat16
+        # bf16 inputs + fp32 accumulation: bounded by bf16 resolution
+        np.testing.assert_allclose(
+            np.asarray(out[k], dtype=np.float32), ref[k],
+            rtol=0.05, atol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# 3. evaluator routing: env-selected kernel path vs the scan reference
+# ---------------------------------------------------------------------------
+
+def _small_scenario():
+    return build_scenario(
+        partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+        dataset=cluster_mlp_dataset(n=240, seed=9, scale=1.0),
+        epoch_count=2, minibatch_count=2)
+
+
+_COALITIONS = [(0,), (1,), (2,), (0, 1), (0, 2), (1, 2), (0, 1, 2)]
+
+
+def _recon_values(monkeypatch, mode):
+    if mode is None:
+        monkeypatch.delenv("MPLC_TPU_RECON_KERNEL", raising=False)
+    else:
+        monkeypatch.setenv("MPLC_TPU_RECON_KERNEL", mode)
+    c = Contributivity(_small_scenario())
+    recon = c._reconstructor()
+    expect = recon_kernel.resolve(mode or "auto")
+    assert recon.kernel_plan() == expect
+    return np.asarray(recon.evaluate(_COALITIONS), dtype=np.float64)
+
+
+def test_evaluator_interpret_matches_scan(monkeypatch):
+    scan = _recon_values(monkeypatch, "off")
+    kern = _recon_values(monkeypatch, "interpret")
+    # same contraction, different association: ledger-bounded closeness,
+    # not bit-equality
+    np.testing.assert_allclose(kern, scan, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("plan,expect", [
+    ("transient@batch1,transient@batch3", "engine.retries"),
+    ("oom@batch2", "engine.cap_halvings"),
+])
+def test_interpret_fault_ladder_bit_identical(monkeypatch, plan, expect):
+    """The PR-4 invariant extends to the kernel path: fault-injected
+    kernel-mode reconstruction == fault-free kernel-mode reconstruction,
+    bit for bit."""
+    monkeypatch.setenv("MPLC_TPU_RECON_KERNEL", "interpret")
+    monkeypatch.delenv("MPLC_TPU_FAULT_PLAN", raising=False)
+
+    def run():
+        c = Contributivity(_small_scenario())
+        c.GTG_Shapley(sv_accuracy=1.0, min_iter=16, perm_batch=8)
+        return np.array(c.contributivity_scores)
+
+    clean = run()
+    metrics.reset()
+    monkeypatch.setenv("MPLC_TPU_FAULT_PLAN", plan)
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    faulted = run()
+    snap = metrics.snapshot()
+    assert snap["counters"].get("engine.faults_injected", 0) >= 1
+    assert snap["counters"].get(expect, 0) >= 1
+    np.testing.assert_array_equal(clean, faulted)
+
+
+def test_bank_recon_key_separates_kernel_and_precision(monkeypatch):
+    """A scan executable must never serve a kernel query (or fp32 a bf16
+    one) from a shared bank: the recon key covers both axes."""
+    monkeypatch.delenv("MPLC_TPU_RECON_KERNEL", raising=False)
+    c = Contributivity(_small_scenario())
+    recon = c._reconstructor()
+    bank = ProgramBank(c.engine)
+    keys = set()
+    for kernel_plan in [(False, False), (True, True), (True, False)]:
+        for precision in ("fp32", "bf16"):
+            recon._kernel = kernel_plan
+            recon.precision = precision
+            keys.add(bank.recon_key(recon, width=4))
+    assert len(keys) == 6
