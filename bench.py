@@ -51,6 +51,18 @@ Configs (select with BENCH_CONFIG, default "1"):
      equality-pass shard count; the shared MPLC_TPU_COMPILE_CACHE_DIR
      program-bank manifest is what keeps W-1 of the W shards from
      recompiling (per-shard manifest-hit counts in the sidecar).
+  10 live residency tier (mplc_tpu/live/residency.py): BENCH_LIVE_GAMES
+     journal-backed live games (default 1000) of one shared scenario
+     under a BENCH_LIVE_RESIDENT cap (default 128), pressure doubled
+     from 125 games up — at each pressure point a game sample is
+     cold-queried (LRU-evicted, so the query pays the WAL restore: the
+     p99 FRESH-query latency) and re-queried warm (memo path), with
+     eviction/restore totals and restore-latency quantiles in the
+     sidecar's live block. A post-restore exact v(S) sweep feeds the
+     numerics block, so the bench_diff gate proves evict->restore is
+     bit-identical across commits (MPLC_TPU_LIVE_MAX_RESIDENT applies
+     when set; the emitted metric is p99 fresh-query seconds at max
+     pressure)
 
 Workload notes. The reference (saved_experiments results.csv) trains ONE
 fedavg MNIST model in ~589 s wall-clock at 50 epochs and needs one full
@@ -110,6 +122,7 @@ program bank: the warm-up doubles as a cache prime and the sidecar's
 """
 
 import json
+import math
 import os
 import sys
 import threading
@@ -259,7 +272,12 @@ _WORKLOAD_KNOBS = (
     "MPLC_TPU_GTG_TRUNCATION",
     # the live-tier knobs change which coalitions a live query evaluates
     # (pruning), how deep reconstruction replays (round cap) and which
-    # queries survive (deadline) — a different live workload entirely
+    # queries survive (deadline) — a different live workload entirely.
+    # The residency cap decides which queries pay a WAL restore (the very
+    # latency config 10 measures), ingestion opens the POST round path,
+    # and the cluster knobs change a hierarchical query's coalition count
+    "MPLC_TPU_LIVE_CLUSTERS", "MPLC_TPU_LIVE_CLUSTER_TAU",
+    "MPLC_TPU_LIVE_INGEST", "MPLC_TPU_LIVE_MAX_RESIDENT",
     "MPLC_TPU_LIVE_MAX_ROUNDS", "MPLC_TPU_LIVE_PRUNE_TAU",
     "MPLC_TPU_LIVE_QUERY_DEADLINE_SEC",
     "MPLC_TPU_MAX_CAP_HALVINGS", "MPLC_TPU_MAX_RETRIES",
@@ -1158,6 +1176,169 @@ def bench_live(epochs, dtype):
     _emit(metric, last_fresh, 0.0)
 
 
+def bench_residency(epochs, dtype):
+    """Config 10: the bounded-residency live tier (live/residency.py).
+    ONE recorded scenario seeds BENCH_LIVE_GAMES journal-backed live
+    games (default 1000) sharing a single engine, under a
+    BENCH_LIVE_RESIDENT residency cap (default 128). Game-count pressure
+    doubles from 125 up to the total; at every point a spread sample of
+    games is evicted and re-queried — the FRESH query pays admission +
+    WAL replay + full reconstruction, the WARM re-query hits the memo —
+    and nearest-rank p50/p99 of both are recorded per point. The
+    sidecar's live block carries the headline `p99_fresh_query_s` and
+    `restore_s` rows bench_diff gates on, plus the residency manager's
+    eviction/restore totals; its numerics block is one representative
+    game's POST-RESTORE exact v(S) bits, so the committed baseline pair
+    proves evict -> restore -> query bit-identity in CI. The emitted
+    metric is p99 fresh-query seconds at max pressure."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    from mplc_tpu.live import LiveGame, residency
+    from mplc_tpu.obs import numerics as obs_num
+    from mplc_tpu.obs import trace as obs_trace
+    from mplc_tpu.obs.report import format_report, sweep_report
+
+    # titanic default: residency churn is the subject here, not model
+    # cost — the logreg records in seconds on any backend
+    dataset = os.environ.get("BENCH_DATASET", "titanic")
+    n_partners = int(os.environ.get("BENCH_PARTNERS", "5"))
+    total_games = max(2, int(os.environ.get("BENCH_LIVE_GAMES", "1000")))
+    cap = int(os.environ.get("BENCH_LIVE_RESIDENT", "128"))
+    rounds_per_game = int(os.environ.get("BENCH_LIVE_ROUNDS", "6"))
+    sample_n = int(os.environ.get("BENCH_LIVE_SAMPLE", "32"))
+
+    def _pctl_nr(xs, q):
+        s = sorted(xs)
+        return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+
+    sc = _make_scenario(dataset, n_partners, epochs, dtype)
+    work = tempfile.mkdtemp(prefix="mplc_residency_")
+    residency.reset()
+    residency.configure(cap)
+    games = []
+    try:
+        print(f"[bench] residency: recording the shared scenario "
+              f"({dataset}, {n_partners} partners)...",
+              file=sys.stderr, flush=True)
+        with obs_trace.collect() as tele:
+            t_all = time.perf_counter()
+            seed = LiveGame.from_recording(
+                sc, tenant="seed", journal_path=os.path.join(work, "seed.wal"))
+            engine = seed.engine
+            base = seed.round_history()[:rounds_per_game]
+            seed.close()
+            _beat()
+
+            # pressure ladder: 125 -> 250 -> 500 -> ... -> total_games
+            pressures, p = [], min(125, total_games)
+            while p < total_games:
+                pressures.append(p)
+                p *= 2
+            pressures.append(total_games)
+
+            points = []
+            for pressure in pressures:
+                while len(games) < pressure:
+                    i = len(games)
+                    g = LiveGame(sc, tenant=f"t{i:04d}", engine=engine,
+                                 journal_path=os.path.join(work, f"t{i}.wal"))
+                    for deltas, weights in base:
+                        g.append_round(deltas, weights)
+                    games.append(g)
+                    if i % 50 == 0:
+                        _beat()
+                # spread sample across the whole tenancy (coldest included)
+                idx = sorted({round(j * (pressure - 1) / max(1, sample_n - 1))
+                              for j in range(min(sample_n, pressure))})
+                fresh, warm = [], []
+                for gi in idx:
+                    g = games[gi]
+                    g.evict()
+                    t0 = time.perf_counter()
+                    g.query("exact")
+                    fresh.append(time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    g.query("exact")  # warm: memoized
+                    warm.append(time.perf_counter() - t0)
+                st = residency.stats()
+                point = {"games": pressure, "sampled": len(idx),
+                         "p50_fresh_query_s": _pctl_nr(fresh, 0.50),
+                         "p99_fresh_query_s": _pctl_nr(fresh, 0.99),
+                         "p50_warm_query_s": _pctl_nr(warm, 0.50),
+                         "p99_warm_query_s": _pctl_nr(warm, 0.99),
+                         "resident": st["resident"],
+                         "evicted": st["evicted"]}
+                points.append(point)
+                print(f"[bench] residency: games={pressure} "
+                      f"resident={st['resident']}/{cap} "
+                      f"fresh p50={point['p50_fresh_query_s'] * 1e3:.1f}ms "
+                      f"p99={point['p99_fresh_query_s'] * 1e3:.1f}ms "
+                      f"warm p99={point['p99_warm_query_s'] * 1e6:.0f}us",
+                      file=sys.stderr, flush=True)
+                _beat()
+
+            # the bit-identity digest: one representative game's
+            # post-restore exact v(S) — CI diffs these bits against the
+            # committed baseline, so a restore that drifts fails the gate
+            rep_game = games[-1]
+            rep_game.evict()
+            rep_game.query("exact")
+            fp = hashlib.sha256(json.dumps(
+                engine._fingerprint(),
+                sort_keys=True).encode()).hexdigest()[:16]
+            led = obs_num.ValueLedger(fp, meta={
+                "precision": getattr(engine._multi_cfg, "precision", "fp32")})
+            for s, v in rep_game._recon.values.items():
+                if s:
+                    led.record(s, float(v), source="live_restore")
+            _NUMERICS_SIDECAR["block"] = {
+                "engine_fingerprint": led.engine_fingerprint,
+                "reduction_mode": "live_restore",
+                "topology": None,
+                "part_shards": None,
+                "entries": len(led.entries),
+                "values": led.values_bits(),
+            }
+            elapsed = time.perf_counter() - t_all
+        rep = sweep_report(tele)
+        print(format_report(rep), file=sys.stderr, flush=True)
+        stats = residency.stats()
+        top = points[-1]
+        live_block = {
+            "max_resident": cap,
+            "total_games": total_games,
+            "rounds_per_game": rounds_per_game,
+            "p99_fresh_query_s": top["p99_fresh_query_s"],
+            "p99_warm_query_s": top["p99_warm_query_s"],
+            # the p50 WAL-restore second (the manager's retry_after_sec
+            # basis) — bench_diff's live.restore_s row compares this
+            "restore_s": residency.retry_after_sec(),
+            "evictions": stats["evictions"],
+            "restores": stats["restores"],
+            "points": points,
+        }
+        metric = (f"live_residency_{dataset}_{total_games}games_"
+                  f"cap{cap}_p99_fresh")
+        print(f"[bench] residency: evictions={stats['evictions']} "
+              f"restores={stats['restores']} "
+              f"restore p50={live_block['restore_s'] * 1e3:.1f}ms",
+              file=sys.stderr, flush=True)
+        _write_telemetry({"metric": metric, "wallclock_s": elapsed,
+                          "devices": _ndev(), "degraded": _degraded_run(rep),
+                          "live": live_block, "report": rep})
+        _emit(metric, top["p99_fresh_query_s"], 0.0)
+    finally:
+        for g in games:
+            try:
+                g.close()
+            except Exception:
+                pass
+        residency.reset()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_fleet(epochs, dtype):
     """Config 9: the fleet sweep plane — coalition-axis sharding across
     OS processes, with a MEASURED wall-clock-vs-shards curve (the number
@@ -1537,8 +1718,10 @@ def main():
         bench_live(epochs, dtype)
     elif config == "9":
         bench_fleet(epochs, dtype)
+    elif config == "10":
+        bench_residency(epochs, dtype)
     else:
-        raise SystemExit(f"unknown BENCH_CONFIG={config!r} (use 1-9)")
+        raise SystemExit(f"unknown BENCH_CONFIG={config!r} (use 1-10)")
 
     if _watchdog_fired.is_set():
         # The watchdog declared this run dead and its fallback child owns

@@ -259,9 +259,43 @@ SVARM_SAMPLES_ENV = "MPLC_TPU_SVARM_SAMPLES"
 #                              low-latency class (submit_live); 0/unset
 #                              = no default deadline. An explicit
 #                              deadline_sec argument wins.
+#   MPLC_TPU_LIVE_MAX_RESIDENT cap on how many live games keep their
+#                              round stacks in RAM at once (process-wide,
+#                              live/residency.py; read at every residency
+#                              decision). Past the cap, the
+#                              least-recently-used JOURNALED game is
+#                              evicted to a stub and restored from its
+#                              WAL on the next touch (a latency tier, not
+#                              a correctness change — evict/restore/query
+#                              is bit-identical). 0/unset = unbounded
+#                              (the pre-residency behavior).
+#   MPLC_TPU_LIVE_INGEST       "1" enables the telemetry server's
+#                              streaming-ingestion route
+#                              (POST /live/<tenant>/round,
+#                              obs/export.py): live_round wire triples
+#                              are decoded and fed to append_round
+#                              without an in-process call. Off by
+#                              default — a mutating HTTP surface is an
+#                              explicit operator decision.
+#   MPLC_TPU_LIVE_CLUSTERS     cluster count for hierarchical/grouped
+#                              Shapley queries past the 16-partner exact
+#                              wall (live/hierarchy.py; read at query/
+#                              plan time, warn+fallback, clamped to 16).
+#                              0/unset = auto (~sqrt(P)).
+#   MPLC_TPU_LIVE_CLUSTER_TAU  hierarchical clustering threshold in
+#                              [0, 1] (read at query/plan time): partners
+#                              whose DPVS info score falls below tau x
+#                              the max score are grouped into ONE shared
+#                              low-information tail cluster instead of
+#                              being spread across the score-balanced
+#                              clusters. 0 (default) = no tail cluster.
 LIVE_PRUNE_TAU_ENV = "MPLC_TPU_LIVE_PRUNE_TAU"
 LIVE_MAX_ROUNDS_ENV = "MPLC_TPU_LIVE_MAX_ROUNDS"
 LIVE_QUERY_DEADLINE_ENV = "MPLC_TPU_LIVE_QUERY_DEADLINE_SEC"
+LIVE_MAX_RESIDENT_ENV = "MPLC_TPU_LIVE_MAX_RESIDENT"
+LIVE_INGEST_ENV = "MPLC_TPU_LIVE_INGEST"
+LIVE_CLUSTERS_ENV = "MPLC_TPU_LIVE_CLUSTERS"
+LIVE_CLUSTER_TAU_ENV = "MPLC_TPU_LIVE_CLUSTER_TAU"
 
 # Sweep service (mplc_tpu/service/): the long-lived multi-tenant
 # scheduler — bounded submission queue, round-robin slicing across
@@ -618,6 +652,16 @@ ENV_KNOBS = {
     "MPLC_TPU_LIVE_PRUNE_TAU": "workload",
     "MPLC_TPU_LIVE_MAX_ROUNDS": "workload",
     "MPLC_TPU_LIVE_QUERY_DEADLINE_SEC": "workload",
+    # the residency/ingestion/hierarchy knobs shape the live workload the
+    # same way: the residency cap decides which queries pay a WAL restore
+    # (the very latency a residency bench measures), the ingestion gate
+    # opens a mutating HTTP surface, and the cluster count/tau decide how
+    # many coalitions a hierarchical query evaluates — none may leak into
+    # a cached replay or the CPU-fallback child
+    "MPLC_TPU_LIVE_MAX_RESIDENT": "workload",
+    "MPLC_TPU_LIVE_INGEST": "workload",
+    "MPLC_TPU_LIVE_CLUSTERS": "workload",
+    "MPLC_TPU_LIVE_CLUSTER_TAU": "workload",
     "MPLC_TPU_FAULT_PLAN": "workload",
     "MPLC_TPU_MAX_CAP_HALVINGS": "workload",
     "MPLC_TPU_MAX_RETRIES": "workload",

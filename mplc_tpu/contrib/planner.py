@@ -37,12 +37,18 @@ inputs, every row carries its reason):
   1. exact        P <= MAX_EXACT_PARTNERS and the 2^P - 1 sweep fits the
                   deadline (no deadline = loose: any exact-capable game
                   routes exact).
-  2. GTG-Shapley  the truncated-permutation budget (min_iter x P evals)
+  2. hierarchical live games past the exact wall (P > 16) whose grouped
+                  sweep — the 2^k cluster powerset plus exact intra
+                  splits (live/hierarchy.py) — fits the deadline: exact
+                  macro Shapley over DPVS-score clusters, split within.
+                  The cluster count/tau knobs are frozen into the plan's
+                  method_kw so a journaled plan replays bit-identically.
+  3. GTG-Shapley  the truncated-permutation budget (min_iter x P evals)
                   fits the deadline (or no deadline on a big game).
-  3. SVARM        tighter deadlines: its explicit sample budget is
+  4. SVARM        tighter deadlines: its explicit sample budget is
                   clamped to what the deadline affords (anchors +
                   stratum warm-up + at least the 128-sample floor).
-  4. DPVS-pruned  deadlines below even SVARM's floor: GTG over the
+  5. DPVS-pruned  deadlines below even SVARM's floor: GTG over the
                   pruned game (live tier; non-live falls back to
                   floor-budget SVARM, best-effort, reason says so).
 """
@@ -74,7 +80,7 @@ MAX_EXACT_PARTNERS = 16
 class QueryPlan:
     """One resolved plan: everything a replay needs to run the same
     concrete query, plus the cost/accuracy evidence behind the choice."""
-    method: str                    # concrete estimator ("exact"/"GTG-Shapley"/"SVARM")
+    method: str                    # "exact"/"hierarchical"/"GTG-Shapley"/"SVARM"
     partners: int
     accuracy_target: float         # contracted trust-row CI half-width
     deadline_sec: "float | None"   # None = loose
@@ -191,7 +197,23 @@ def plan_query(partners_count: int,
             + ("a loose deadline" if deadline_sec is None
                else f"the {deadline_sec:g}s deadline")
             + "; exact Shapley meets any accuracy target (CI width 0)")
-    # 2. GTG-Shapley: permutation sampling to the accuracy target
+    # 2. hierarchical (live only): past the exact wall, exact Shapley
+    # over <= 16 DPVS-score clusters + exact intra splits. The knobs are
+    # resolved HERE and frozen into method_kw — a journaled plan fully
+    # determines the query (same rule as the pruned rung's tau)
+    if live and n > MAX_EXACT_PARTNERS:
+        from ..live import hierarchy as _hier
+        k = _hier.resolve_clusters(n)
+        ctau = _hier.resolve_cluster_tau()
+        hier_evals = _hier.estimate_evaluations(n, k)
+        if _fits(hier_evals):
+            return _plan(
+                "hierarchical", hier_evals, 0.0,
+                f"game too large for the exact table (P={n} > "
+                f"{MAX_EXACT_PARTNERS}) but the grouped sweep over {k} "
+                "clusters fits; exact macro Shapley + exact intra splits",
+                clusters=int(k), cluster_tau=float(ctau))
+    # 3. GTG-Shapley: permutation sampling to the accuracy target
     if _fits(evals["GTG-Shapley"]):
         reason = (f"game too large for the exact table (P={n} > "
                   f"{MAX_EXACT_PARTNERS})" if n > MAX_EXACT_PARTNERS
@@ -200,7 +222,7 @@ def plan_query(partners_count: int,
             "GTG-Shapley", evals["GTG-Shapley"], 0.0,
             reason + "; truncated-permutation budget fits",
             sv_accuracy=float(accuracy_target))
-    # 3. SVARM: explicit budget clamped to the deadline
+    # 4. SVARM: explicit budget clamped to the deadline
     if _fits(evals["SVARM_floor"]):
         affordable = int(deadline_sec / eval_sec) if deadline_sec else 0
         overhead = evals["SVARM_floor"] - _SVARM_FLOOR
@@ -211,7 +233,7 @@ def plan_query(partners_count: int,
             "deadline below the GTG permutation budget; SVARM's sample "
             f"budget clamps to {budget} coalitions",
             budget=int(budget))
-    # 4. pruned (live) / floor-budget SVARM (best-effort, non-live)
+    # 5. pruned (live) / floor-budget SVARM (best-effort, non-live)
     if live:
         tau = constants._env_nonneg_float(
             constants.LIVE_PRUNE_TAU_ENV, 0.0) or _PRUNE_TAU_FALLBACK

@@ -36,6 +36,14 @@ append. A kill→restart restores the game bit-identically — floats
 round-trip exactly through the JSON encoding, so a restored game's
 queries equal the killed game's (equality-tested).
 
+Residency: round stacks are RAM unless the process-wide residency
+manager (live/residency.py, `MPLC_TPU_LIVE_MAX_RESIDENT`) evicts a cold
+journal-backed game down to a stub. The WAL journals every round exactly,
+so the next touch restores through the same replay path a restart uses —
+eviction is a latency tier, and evict -> restore -> query is
+bit-identical to never-evicted (equality-tested in
+tests/test_live_residency.py).
+
 Execution: queries run through `ReconstructionEvaluator` — the same
 merged slot buckets, device-batch caps, fault ladder and span/event
 vocabulary as every other reconstruction — with the program bank
@@ -63,16 +71,20 @@ from ..contrib.reconstruct import RecordedRun, _check_not_2d
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..service.journal import SweepJournal
+from . import residency
 from .dpvs import PrunedReconstruction, info_scores, low_information
 
 logger = logging.getLogger("mplc_tpu")
 
 #: Methods `LiveGame.query` answers ("Shapley values" aliases "exact").
-LIVE_METHODS = ("exact", "GTG-Shapley", "SVARM")
+LIVE_METHODS = ("exact", "hierarchical", "GTG-Shapley", "SVARM")
 
 # exact queries materialize the 2^P host-side table (shapley weights over
 # every bitmask) — past this partner count the host cost alone breaks the
-# sub-second contract; the sampling methods have no such bound
+# sub-second contract. Past the wall, "hierarchical" (live/hierarchy.py)
+# reuses this whole exact path over <= 16 CLUSTERS of partners, and the
+# sampling methods have no bound at all — exact-per-partner is capped,
+# large games are not refused.
 MAX_EXACT_PARTNERS = 16
 
 
@@ -80,7 +92,22 @@ class LiveGameFull(RuntimeError):
     """append_round past the resident-round cap
     (`MPLC_TPU_LIVE_MAX_ROUNDS`): the game refuses to grow its
     reconstruction depth and journal without bound. Start a new game (or
-    raise the cap) — silently evicting history would change v(S)."""
+    raise the cap) — silently evicting history would change v(S).
+
+    Carries a `retry_after_sec` backoff hint (0.0 = no estimate), the
+    `ServiceOverloaded` convention, so streaming clients back off
+    instead of hammering the ingestion endpoint."""
+
+    def __init__(self, msg, retry_after_sec: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_sec = float(retry_after_sec)
+
+
+class LiveResidencyFull(LiveGameFull):
+    """Residency admission refused: the process is at the
+    `MPLC_TPU_LIVE_MAX_RESIDENT` cap and no resident game is evictable
+    (journal-less or busy). The `retry_after_sec` hint is the p50 of
+    recent WAL-restore latencies (live/residency.py)."""
 
 
 class LiveQueryResult:
@@ -182,6 +209,11 @@ class LiveGame:
         self._recon_stamp = -1
         self._results: dict = {}
         self._info_cache = None  # (stamp, rounds_resident) -> scores
+        # residency state: an evicted game keeps only this stub —
+        # (round_stamp, rounds) at eviction, integrity-checked on restore
+        self._evicted = False
+        self._evicted_state = (0, 0)
+        self.last_restore_s = 0.0
         # one game = one serialized surface: the service's worker POOL
         # can land two live-query quanta (or an append racing a query)
         # for the same tenant on different workers, and the evaluator /
@@ -199,6 +231,14 @@ class LiveGame:
                     "partners_count": int(engine.partners_count),
                     "model": getattr(engine.model, "name", "?"),
                     "params": _encode_tree(self._init_params)})
+        # residency admission: past the MPLC_TPU_LIVE_MAX_RESIDENT cap
+        # this evicts the coldest journal-backed game — or refuses THIS
+        # game (LiveResidencyFull) when nothing is evictable
+        try:
+            residency.admit(self)
+        except BaseException:
+            self.close()
+            raise
         self._set_gauges()
 
     # -- construction helpers -------------------------------------------
@@ -287,12 +327,80 @@ class LiveGame:
 
     def round_history(self) -> list:
         """The resident `(deltas, weights)` rounds, in append order
-        (host arrays; the bench's append-replay loop reads this)."""
-        return list(self._rounds)
+        (host arrays; the bench's append-replay loop reads this).
+        Restores an evicted game first."""
+        with self._lock:
+            self._ensure_resident()
+            return list(self._rounds)
 
     def _set_gauges(self) -> None:
         obs_metrics.gauge("live.rounds_resident",
                           tenant=self.tenant).set(len(self._rounds))
+
+    # -- residency (live/residency.py calls in; queries call out) --------
+
+    @property
+    def resident(self) -> bool:
+        return not self._evicted
+
+    def evict(self) -> bool:
+        """Evict this game's round stack (and every derived evaluator/
+        memo) down to a stub. Only journal-backed games are evictable —
+        the WAL holds every round exactly, so the next touch restores
+        bit-identically. Returns False (still resident) without a
+        journal. Normally driven by the residency manager's LRU, public
+        for tests and operators."""
+        with self._lock:
+            return self._evict_locked()
+
+    def _evict_locked(self) -> bool:
+        if self._journal is None or self._evicted:
+            return False
+        rounds = len(self._rounds)
+        self._evicted_state = (self.round_stamp, rounds)
+        self._rounds = []
+        self._recon = None
+        self._recon_stamp = -1
+        self._results = {}
+        self._info_cache = None
+        self._evicted = True
+        residency.note_evicted(self)
+        obs_metrics.counter("live.evictions").inc()
+        obs_trace.event("live.evict", tenant=self.tenant, rounds=rounds,
+                        stamp=self.round_stamp)
+        self._set_gauges()
+        return True
+
+    def _ensure_resident(self) -> None:
+        """Restore an evicted game's round stack from its WAL (the same
+        `live.recover` replay path a restart uses) before any read or
+        append; LRU-bump otherwise. Caller holds the lock."""
+        if not self._evicted:
+            residency.touch(self)
+            return
+        # admission first: restoring must not blow the cap, and a refusal
+        # (LiveResidencyFull, with backoff hint) leaves the stub intact
+        residency.admit(self)
+        t0 = time.perf_counter()
+        records, _torn = SweepJournal.replay(self._journal.path)
+        saved_stamp, saved_rounds = self._evicted_state
+        self.round_stamp = 0
+        self._restore(records)
+        if (self.round_stamp, len(self._rounds)) != (saved_stamp,
+                                                     saved_rounds):
+            raise RuntimeError(
+                f"live game {self.tenant!r} restored to "
+                f"(stamp={self.round_stamp}, rounds={len(self._rounds)}) "
+                f"but was evicted at (stamp={saved_stamp}, "
+                f"rounds={saved_rounds}) — the WAL and the stub disagree")
+        self._evicted = False
+        self.last_restore_s = time.perf_counter() - t0
+        residency.note_restore(self.last_restore_s)
+        obs_metrics.counter("live.restores").inc()
+        obs_trace.event("live.restore", tenant=self.tenant,
+                        rounds=len(self._rounds), stamp=self.round_stamp,
+                        restore_s=round(self.last_restore_s, 6))
+        self._set_gauges()
 
     def append_round(self, deltas, weights) -> int:
         """Append one aggregation round's per-partner deltas (`[P, ...]`
@@ -327,6 +435,7 @@ class LiveGame:
         """Append a batch of rounds with ONE journal durability point
         (`append_many` — from_recording seeds epochs x minibatches rounds
         and must not pay one fsync per round). Caller holds the lock."""
+        self._ensure_resident()
         if len(self._rounds) + len(rounds) > self.max_rounds:
             raise LiveGameFull(
                 f"live game for tenant {self.tenant!r} holds "
@@ -415,7 +524,10 @@ class LiveGame:
         """Answer a contributivity query from the resident game.
 
         `method`: "exact" (full reconstructed powerset + exact Shapley;
-        partner counts <= 16), "GTG-Shapley" or "SVARM" (their usual
+        partner counts <= 16), "hierarchical" (DPVS-clustered grouped
+        Shapley for larger games — exact over <= 16 clusters, split
+        within; `clusters`/`cluster_tau` kwargs, live/hierarchy.py),
+        "GTG-Shapley" or "SVARM" (their usual
         kwargs pass through), or "auto" — the adaptive planner
         (contrib/planner.py) resolves (game size, `accuracy_target`,
         `deadline_sec`) to a concrete method + pruning tau, the plan
@@ -438,6 +550,7 @@ class LiveGame:
                       accuracy_target: "float | None" = None,
                       deadline_sec: "float | None" = None
                       ) -> LiveQueryResult:
+        self._ensure_resident()
         if method == "Shapley values":
             method = "exact"
         plan = None
@@ -520,13 +633,20 @@ class LiveGame:
                     raise ValueError(
                         f"live exact queries are limited to "
                         f"{MAX_EXACT_PARTNERS} partners (the 2^P host "
-                        f"table; this game has {n}) — use GTG-Shapley or "
-                        "SVARM")
+                        f"table; this game has {n}) — use hierarchical, "
+                        "GTG-Shapley or SVARM")
                 from ..contrib.shapley import (powerset_order,
                                                shapley_from_characteristic)
                 ev.evaluate(powerset_order(n))
                 scores = np.asarray(
                     shapley_from_characteristic(n, ev.values))
+            elif method == "hierarchical":
+                from .hierarchy import hierarchical_shapley
+                scores, hdetail = hierarchical_shapley(
+                    ev, n, self._info_scores(), **method_kw)
+                span.attrs.update(
+                    clusters=len(hdetail["clusters"]),
+                    proportional_splits=hdetail["proportional_splits"])
             else:
                 from ..contrib.contributivity import Contributivity
                 eng = self.engine
@@ -576,8 +696,13 @@ class LiveGame:
             "results_cached": len(self._results),
             "max_rounds": self.max_rounds,
             "journal": self._journal.path if self._journal else None,
+            # residency state: an observability read must never trigger
+            # a restore, so this reports the stub as-is
+            "resident": self.resident,
+            "last_restore_s": round(self.last_restore_s, 6),
         }
 
     def close(self) -> None:
+        residency.forget(self)
         if self._journal is not None:
             self._journal.close()

@@ -86,10 +86,19 @@ METRICS_PORT_ENV = "MPLC_TPU_METRICS_PORT"
 # default behavior, unchanged.
 METRICS_TOKEN_ENV = "MPLC_TPU_METRICS_TOKEN"
 
+# Streaming round ingestion (the live tier's decoupled arrival path):
+# when set to "1", the server grows `POST /live/<tenant>/round` — one
+# live_round wire document per request, fed to the registered service
+# sink (SweepService._ingest_live_round). Off by default: a MUTATING
+# HTTP surface is an explicit operator decision, unlike the read-only
+# routes above.
+LIVE_INGEST_ENV = "MPLC_TPU_LIVE_INGEST"
+
 _lock = threading.Lock()
 _server: "TelemetryServer | None" = None
 _health_providers: dict = {}
 _varz_providers: dict = {}
+_live_ingest_sinks: dict = {}
 
 
 # -- provider registry --------------------------------------------------------
@@ -109,10 +118,21 @@ def register_varz(name: str, fn) -> None:
         _varz_providers[name] = fn
 
 
+def register_live_ingest(name: str, fn) -> None:
+    """Register a streaming-ingestion sink: `fn(tenant, doc)` feeds one
+    decoded live_round wire document to the tenant's resident game and
+    returns a JSON-ready ack. Same WeakMethod auto-unregister contract
+    as the health/varz providers. The POST route only exists when
+    `MPLC_TPU_LIVE_INGEST=1`."""
+    with _lock:
+        _live_ingest_sinks[name] = fn
+
+
 def unregister(name: str) -> None:
     with _lock:
         _health_providers.pop(name, None)
         _varz_providers.pop(name, None)
+        _live_ingest_sinks.pop(name, None)
 
 
 def _call_providers(providers: dict) -> dict:
@@ -129,6 +149,33 @@ def _call_providers(providers: dict) -> dict:
         except Exception as e:  # a broken provider must not 500 the route
             out[name] = {"healthy": False, "error": str(e)[:500]}
     return out
+
+
+def live_ingest(tenant: str, doc: dict) -> dict:
+    """Dispatch one live_round wire document to the registered
+    ingestion sinks. A tenant's game lives in exactly one service, so a
+    sink that doesn't know the tenant raises KeyError and the next is
+    tried. Raises LookupError with no sink registered (503), the last
+    KeyError when none knows the tenant (404); the sink's ValueError
+    (400) and LiveGameFull-with-retry_after_sec (429) propagate."""
+    with _lock:
+        sinks = dict(_live_ingest_sinks)
+    last: "KeyError | None" = None
+    for name, fn in sorted(sinks.items()):
+        if isinstance(fn, weakref.WeakMethod):
+            live = fn()
+            if live is None:
+                unregister(name)  # the owner was collected
+                continue
+            fn = live
+        try:
+            return fn(tenant, doc)
+        except KeyError as e:
+            last = e
+    if last is not None:
+        raise last
+    raise LookupError("no live ingestion sink registered (is a "
+                      "SweepService running in this process?)")
 
 
 def health_view() -> tuple[bool, dict]:
@@ -363,6 +410,12 @@ def redact_varz(doc, viewer: "str | None" = None,
                                    row.get("rounds_resident"),
                                "round_stamp": row.get("round_stamp"),
                                "queries": row.get("queries"),
+                               # residency state stays readable (load
+                               # signals, not identity); the journal
+                               # PATH is dropped with the rest
+                               "resident": row.get("resident"),
+                               "last_restore_s":
+                                   row.get("last_restore_s"),
                                "redacted": True})
                         for t, row in val.items()}
                 elif (k == "shards" and isinstance(val, dict) and val
@@ -462,6 +515,9 @@ def prometheus_text() -> str:
 
 
 # -- the HTTP server ----------------------------------------------------------
+
+_LIVE_ROUND_RE = re.compile(r"^/live/([^/]+)/round$")
+
 
 class _Handler(http.server.BaseHTTPRequestHandler):
     def _auth_role(self, query: str) -> "tuple[str, str | None]":
@@ -569,6 +625,57 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                         "text/plain")
         else:
             self._reply(404, b"not found\n", "text/plain")
+
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        path, _, query = self.path.partition("?")
+        m = _LIVE_ROUND_RE.match(path)
+        if m is None or os.environ.get(LIVE_INGEST_ENV) != "1":
+            # the mutating route doesn't EXIST unless the operator
+            # opted in — a 404, not a 403, so probes learn nothing
+            return self._reply(404, b"not found\n", "text/plain")
+        tenant = urllib.parse.unquote(m.group(1))
+        role, viewer = self._auth_role(query)
+        # per-tenant credentials must match the PATH tenant: tenant A's
+        # token cannot append rounds into tenant B's game
+        if role == "denied" or (role == "tenant" and viewer != tenant):
+            return self._deny()
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            doc = json.loads(self.rfile.read(length).decode())
+            if not isinstance(doc, dict):
+                raise ValueError("round document must be a JSON object")
+        except Exception as e:
+            return self._reply(400, json.dumps(
+                {"error": f"bad request body: {str(e)[:300]}"}).encode(),
+                "application/json")
+        try:
+            ack = live_ingest(tenant, doc)
+        except KeyError as e:
+            return self._reply(404, json.dumps(
+                {"error": str(e)[:500]}).encode(), "application/json")
+        except ValueError as e:
+            return self._reply(400, json.dumps(
+                {"error": str(e)[:500]}).encode(), "application/json")
+        except Exception as e:
+            retry = getattr(e, "retry_after_sec", None)
+            if retry is not None:
+                # LiveGameFull / LiveResidencyFull: the client should
+                # back off, not hammer — the hint rides the standard
+                # header AND the body (sub-second resolution)
+                body = json.dumps({"error": str(e)[:500],
+                                   "retry_after_sec": float(retry)})
+                self.send_response(429)
+                self.send_header("Retry-After",
+                                 str(max(1, int(float(retry) + 0.5))))
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body.encode())
+                return
+            return self._reply(503, json.dumps(
+                {"error": str(e)[:500]}).encode(), "application/json")
+        self._reply(200, json.dumps(ack, default=str).encode(),
+                    "application/json")
 
     def _deny(self) -> None:
         self.send_response(401)

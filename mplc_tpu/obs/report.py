@@ -130,6 +130,8 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     per_method: dict = {}
     live_queries: list = []         # (dur, attrs) of live.query events
     live_appends = live_recovers = 0
+    live_evictions = live_ingested = 0
+    live_restores: list = []        # restore_s of live.restore events
     # adaptive query planner (contrib/planner.py): every contrib.plan /
     # live.plan event is one method="auto" resolution
     plans: list = []
@@ -339,6 +341,12 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             live_appends += 1
         elif name == "live.recover":
             live_recovers += 1
+        elif name == "live.evict":
+            live_evictions += 1
+        elif name == "live.restore":
+            live_restores.append(float(a.get("restore_s") or 0.0))
+        elif name == "live.ingest":
+            live_ingested += 1
         elif name == "contrib.trust":
             # one trust row per sweep; the last event wins (a re-run of
             # the estimator within one collected region supersedes)
@@ -589,7 +597,8 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
         report["roofline"] = {"peak_flops": peak_flops,
                               "hbm_peak_bytes_per_s": hbm_bytes_per_s,
                               "programs": rows}
-    if live_queries or live_appends or live_recovers:
+    if (live_queries or live_appends or live_recovers or live_evictions
+            or live_restores or live_ingested):
         # the live contributivity tier's view: fresh-query latency (memo
         # hits kept separate — they answer in microseconds and would
         # flatter the quantiles), evaluation/pruning totals, and the
@@ -609,6 +618,18 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
                                      for _d, a in live_queries),
             "rounds_appended": live_appends,
             "recovered_games": live_recovers,
+            # the residency tier (live/residency.py): evictions seen in
+            # the collected region, restores + their WAL-replay latency
+            # quantiles (ingested counts the POST /live/<t>/round path)
+            "evictions": live_evictions,
+            "restores": len(live_restores),
+            "restore_s": {
+                "count": len(live_restores),
+                "p50": _pctl(sorted(live_restores), 0.50),
+                "p95": _pctl(sorted(live_restores), 0.95),
+                "max": max(live_restores) if live_restores else None,
+            },
+            "rounds_ingested": live_ingested,
             "rounds_resident": (int(live_queries[-1][1].get("rounds", 0))
                                 if live_queries else None),
             "per_method": per_m,
@@ -867,6 +888,10 @@ def format_report(report: dict) -> str:
             f"rounds={lv.get('rounds_resident') if lv.get('rounds_resident') is not None else '?'}"
             + (f"  recovered={lv['recovered_games']}"
                if lv.get("recovered_games") else "")
+            + (f"  evicted/restored={lv['evictions']}/{lv['restores']}"
+               if lv.get("evictions") or lv.get("restores") else "")
+            + (f"  ingested={lv['rounds_ingested']}"
+               if lv.get("rounds_ingested") else "")
             + f"  query p50/p95={_s(q.get('p50'))}/{_s(q.get('p95'))}")
     pl = report.get("planner")
     if pl is not None:

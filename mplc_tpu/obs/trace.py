@@ -128,6 +128,12 @@ SPAN_REGISTRY = {
                    "game (attrs: tenant/seq/stamp/invalidating)",
     "live.recover": "journal-restored live game (attrs: tenant/rounds/"
                     "stamp)",
+    "live.evict": "live game's round stack LRU-evicted to a WAL-backed "
+                  "stub (attrs: tenant/rounds/stamp)",
+    "live.restore": "evicted live game restored from its WAL on touch "
+                    "(attrs: tenant/rounds/stamp/restore_s)",
+    "live.ingest": "one wire round accepted via POST /live/<tenant>/"
+                   "round (attrs: tenant/stamp/rounds)",
     "service.journal_broken": "WAL append failure (journaling disabled)",
     "flight.dump": "flight-recorder postmortem written (attrs: reason/"
                    "path)",

@@ -152,6 +152,14 @@ from .packer import CrossTenantPacker
 
 logger = logging.getLogger("mplc_tpu")
 
+
+def _live_residency_stats() -> dict:
+    """The residency manager's /varz block (lazy import: the live tier
+    is only loaded once a service actually touches it)."""
+    from ..live import residency
+    return residency.stats()
+
+
 # /healthz stall rule: the service is unhealthy when a job is RUNNING and
 # the worker heartbeat (beaten at every quantum start and every batch
 # boundary) is older than this — a single device batch legitimately
@@ -527,6 +535,11 @@ class SweepService:
                                    weakref.WeakMethod(self.health_view))
         obs_export.register_varz(self._provider_key,
                                  weakref.WeakMethod(self.varz_view))
+        # streaming ingestion sink (POST /live/<tenant>/round): same
+        # WeakMethod lifetime contract as the health/varz providers; the
+        # route itself only exists when MPLC_TPU_LIVE_INGEST=1
+        obs_export.register_live_ingest(
+            self._provider_key, weakref.WeakMethod(self._ingest_live_round))
 
         # lifetime device-seconds metered per tenant (obs/devcost.py) —
         # fed by every quantum's meter delta AND by journal replay below
@@ -758,6 +771,10 @@ class SweepService:
                 # on /metrics)
                 "live_games": {t: g.describe()
                                for t, g in sorted(self._live_games.items())},
+                # the process-wide residency manager's state: resident/
+                # evicted counts, lifetime evictions/restores, last
+                # WAL-restore latency (live/residency.py)
+                "live_residency": _live_residency_stats(),
                 # lifetime metered device-seconds per tenant (restored
                 # from the journal on restart — the billing meter)
                 "tenant_device_seconds": {
@@ -984,6 +1001,35 @@ class SweepService:
                 "first")
         return game.append_round(deltas, weights)
 
+    def _ingest_live_round(self, tenant: str, doc: dict) -> dict:
+        """The telemetry server's streaming-ingestion sink
+        (`POST /live/<tenant>/round`, obs/export.py, gated on
+        `MPLC_TPU_LIVE_INGEST=1`): decode one wire round — `{"deltas":
+        [[shape, dtype, flat-values], ...], "weights": [P floats]}`, the
+        exact triples the WAL journals for `live_round` records — and
+        feed the tenant's resident game, so round arrival needs no
+        in-process call. Error contract (mapped to HTTP by the handler):
+        KeyError = unknown tenant (404), ValueError = malformed round
+        (400); `LiveGameFull`/`LiveResidencyFull` propagate with their
+        `retry_after_sec` backoff hint (429 + Retry-After)."""
+        game = self._live_games.get(tenant)
+        if game is None:
+            raise KeyError(f"no live game for tenant {tenant!r}")
+        from ..live.game import _decode_tree
+        try:
+            deltas = _decode_tree(doc["deltas"], game._treedef)
+            weights = np.asarray(doc["weights"], np.float32)
+        except Exception as e:
+            raise ValueError(f"malformed live_round document ({e}); "
+                             'expected {"deltas": [[shape, dtype, '
+                             'flat-values], ...], "weights": [P floats]}')
+        stamp = game.append_round(deltas, weights)
+        obs_metrics.counter("live.rounds_ingested").inc()
+        obs_trace.event("live.ingest", tenant=game.tenant, stamp=stamp,
+                        rounds=game.rounds_resident)
+        return {"tenant": game.tenant, "stamp": stamp,
+                "rounds_resident": game.rounds_resident}
+
     def submit_live(self, tenant: str, method: str = "GTG-Shapley",
                     deadline_sec: "float | None" = None,
                     job_id: "str | None" = None,
@@ -999,10 +1045,10 @@ class SweepService:
         queries are the latency-sensitive traffic the governor protects)
         with `MPLC_TPU_LIVE_QUERY_DEADLINE_SEC` as the default deadline
         (0/unset = none; an explicit `deadline_sec` wins). `method` is
-        "exact" | "GTG-Shapley" | "SVARM" | "auto"; `prune` is the DPVS
-        threshold tau (None = the env default). The answer is
-        `job.result()` (the scores) with the full `LiveQueryResult` on
-        `job.live_result`.
+        "exact" | "hierarchical" | "GTG-Shapley" | "SVARM" | "auto";
+        `prune` is the DPVS threshold tau (None = the env default). The
+        answer is `job.result()` (the scores) with the full
+        `LiveQueryResult` on `job.live_result`.
 
         `method="auto"` resolves HERE, synchronously: the adaptive
         planner (contrib/planner.py) routes
@@ -1011,7 +1057,12 @@ class SweepService:
         resolved QueryPlan is pinned into the live spec AND the journal's
         submit record (a replay runs the same concrete query, never a
         re-plan), and the plan's prune tau wins when the caller passed
-        none — even tau=0 (unpruned) is the plan's decision."""
+        none — even tau=0 (unpruned) is the plan's decision. The plan is
+        admission-aware: the queue's measured p50 wait is subtracted
+        from the deadline before routing (floored at a tenth of the
+        SLO), so the chosen estimator fits what REMAINS of the tier's
+        SLO after queueing, not the wall-clock deadline the job itself
+        is still held to."""
         game = self._live_games.get(tenant)
         if game is None:
             raise ServiceError(
@@ -1027,8 +1078,19 @@ class SweepService:
             from ..contrib.planner import (estimate_eval_seconds,
                                            plan_query)
             eval_sec, basis = estimate_eval_seconds(game.engine)
+            # admission-aware per-tier SLO: a queued job spends the
+            # queue's current p50 wait before any compute runs, so the
+            # planner routes against the COMPUTE budget that remains of
+            # the deadline (floored at a tenth — a saturated queue must
+            # degrade the method choice, not zero the budget). The job's
+            # own deadline stays the full SLO.
+            plan_deadline = deadline_sec
+            if deadline_sec is not None:
+                wait = self._admission.retry_after_sec()
+                plan_deadline = max(float(deadline_sec) - wait,
+                                    float(deadline_sec) * 0.1)
             plan = plan_query(game.engine.partners_count,
-                              accuracy_target, deadline_sec,
+                              accuracy_target, plan_deadline,
                               eval_sec=eval_sec, cost_basis=basis,
                               live=True)
             method = plan.method
@@ -1045,7 +1107,7 @@ class SweepService:
             raise ValueError(
                 f"live exact queries are limited to {MAX_EXACT_PARTNERS} "
                 f"partners (this game has {game.engine.partners_count}) "
-                "— use GTG-Shapley or SVARM")
+                "— use hierarchical, GTG-Shapley or SVARM")
         if prune is not None and not 0.0 <= float(prune) <= 1.0:
             raise ValueError(
                 f"prune tau must be in [0, 1], got {prune}")

@@ -87,6 +87,12 @@ _ROWS = {
     # faster, which is fine, hence "lower")
     "recon.kernel_query_s": "lower",
     "precision.fp32_reference_s": "lower",
+    # residency-tier rows (config 10 sidecar, `live` block at top
+    # level): p99 fresh-query latency at max game pressure (a fresh
+    # query pays admission + WAL replay + full reconstruction) and the
+    # p50 WAL-restore second (the manager's retry_after_sec basis)
+    "live.p99_fresh_query_s": "lower",
+    "live.restore_s": "lower",
 }
 
 #: a non-fp32 run's Kendall tau-b against its own fp32 reference twin

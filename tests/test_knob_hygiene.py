@@ -88,12 +88,18 @@ def test_static_scan_covers_the_live_package():
     # the live knobs reshape what a live-query bench run computes
     # (pruning schedule, reconstruction depth, deadline survival)
     for knob in ("MPLC_TPU_LIVE_PRUNE_TAU", "MPLC_TPU_LIVE_MAX_ROUNDS",
-                 "MPLC_TPU_LIVE_QUERY_DEADLINE_SEC"):
+                 "MPLC_TPU_LIVE_QUERY_DEADLINE_SEC",
+                 # the residency/ingestion/hierarchy tier (ISSUE 18):
+                 # cap, ingestion opt-in and clustering shape all change
+                 # what a BENCH_CONFIG=10 run measures
+                 "MPLC_TPU_LIVE_MAX_RESIDENT", "MPLC_TPU_LIVE_INGEST",
+                 "MPLC_TPU_LIVE_CLUSTERS", "MPLC_TPU_LIVE_CLUSTER_TAU"):
         assert constants.ENV_KNOBS.get(knob) == "workload", knob
     # and the tier's trace vocabulary is registered (consumers: the
     # report's live row, the Perfetto exporter)
     from mplc_tpu.obs.trace import SPAN_REGISTRY
-    for name in ("live.query", "live.append", "live.recover"):
+    for name in ("live.query", "live.append", "live.recover",
+                 "live.evict", "live.restore", "live.ingest"):
         assert name in SPAN_REGISTRY, name
 
 

@@ -231,15 +231,21 @@ def test_varz_live_games_redacted_for_other_tenants():
     doc = {"live_games": {
         "acme": {"tenant": "acme", "rounds_resident": 7, "round_stamp": 3,
                  "queries": 2, "results_cached": 1, "max_rounds": 4096,
+                 "resident": False, "last_restore_s": 0.125,
                  "journal": "/secret/path/wal.jsonl"},
         "beta": {"tenant": "beta", "rounds_resident": 1, "round_stamp": 1,
                  "queries": 0, "results_cached": 0, "max_rounds": 4096,
+                 "resident": True, "last_restore_s": 0.0,
                  "journal": None}}}
     red = redact_varz(doc, viewer="beta", key="master")
     assert "beta" in red["live_games"]  # the viewer keeps its own row
     assert red["live_games"]["beta"]["journal"] is None
     others = [v for k, v in red["live_games"].items() if k != "beta"]
     assert len(others) == 1 and others[0]["redacted"] is True
+    # residency state is a load signal, not an identity: it survives
+    # redaction so co-tenants can reason about cache pressure
+    assert others[0]["resident"] is False
+    assert others[0]["last_restore_s"] == 0.125
     body = str(red)
     assert "acme" not in body and "/secret/path" not in body
 

@@ -1,0 +1,170 @@
+"""Streaming round ingestion: `POST /live/<tenant>/round` on the
+telemetry server (obs/export.py) feeding `LiveGame.append_round` through
+the service's registered sink (service/scheduler.py) — round arrival
+with no in-process call.
+
+The contract under test:
+
+1. **Opt-in existence.** The mutating route only EXISTS when
+   `MPLC_TPU_LIVE_INGEST=1` — without the knob every POST is a 404
+   (probes learn nothing), with it an ingested round advances the
+   resident game's stamp exactly like an in-process append.
+2. **Authenticated tenancy.** In token mode the per-tenant HMAC
+   credential must match the PATH tenant: tenant B's token cannot
+   append into tenant A's game (401), the operator master can, and a
+   missing/garbage token is denied.
+3. **Error contract.** Unknown tenant 404, malformed document 400, and
+   a full game 429 carrying the `retry_after_sec` hint in both the
+   standard Retry-After header and the JSON body.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from helpers import build_scenario, cluster_mlp_dataset
+from mplc_tpu.live.game import _encode_tree
+from mplc_tpu.obs import export as obs_export
+
+
+def _scenario_3p(seed=3):
+    return build_scenario(
+        partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+        dataset=cluster_mlp_dataset(n=240, seed=9, scale=1.0),
+        epoch_count=2, minibatch_count=2, seed=seed)
+
+
+def _wire_round(game, seed=0, scale=0.08):
+    """One live_round wire document: the exact [[shape, dtype, values]]
+    triples the WAL journals."""
+    rng = np.random.default_rng(seed)
+    P = game.engine.partners_count
+    deltas = jax.tree_util.tree_map(
+        lambda l: rng.normal(0, scale, (P,) + l.shape).astype(l.dtype),
+        game._init_params)
+    w = rng.dirichlet(np.ones(P)).astype(np.float32)
+    return {"deltas": _encode_tree(deltas), "weights": w.tolist()}
+
+
+def _post(url, doc, token=None):
+    """(status, parsed-JSON-body-or-None, headers)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"null"), \
+                dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            parsed = json.loads(body) if body else None
+        except ValueError:
+            parsed = None
+        return e.code, parsed, dict(e.headers)
+
+
+@pytest.fixture()
+def live_service(monkeypatch):
+    """A stopped-scheduler service with one resident game ("acme") and a
+    loopback telemetry server, ingestion knob ON."""
+    from mplc_tpu.service import SweepService
+
+    monkeypatch.delenv("MPLC_TPU_METRICS_TOKEN", raising=False)
+    monkeypatch.setenv("MPLC_TPU_LIVE_INGEST", "1")
+    svc = SweepService(start=False)
+    game = svc.live_game(_scenario_3p(seed=61), tenant="acme")
+    srv = obs_export.TelemetryServer(0)
+    try:
+        yield svc, game, f"http://127.0.0.1:{srv.port}"
+    finally:
+        srv.close()
+        svc.shutdown(drain=False)
+
+
+def test_ingested_round_equals_in_process_append(live_service):
+    svc, game, base = live_service
+    doc = _wire_round(game, seed=62)
+    status, ack, _ = _post(f"{base}/live/acme/round", doc)
+    assert status == 200
+    assert ack == {"tenant": "acme", "stamp": game.round_stamp,
+                   "rounds_resident": 1}
+    assert game.rounds_resident == 1
+    # the decoded round is bit-identical to an in-process append of the
+    # same arrays: a twin game fed directly answers identically
+    twin = svc.live_game(_scenario_3p(seed=61), tenant="twin")
+    deltas, w = game.round_history()[0]
+    twin.append_round(deltas, w)
+    np.testing.assert_array_equal(twin.query("exact").scores,
+                                  game.query("exact").scores)
+
+
+def test_route_does_not_exist_without_opt_in(live_service, monkeypatch):
+    _, game, base = live_service
+    monkeypatch.delenv("MPLC_TPU_LIVE_INGEST")
+    status, _, _ = _post(f"{base}/live/acme/round", _wire_round(game))
+    assert status == 404
+    assert game.rounds_resident == 0
+
+
+def test_unknown_tenant_404_and_malformed_400(live_service):
+    _, game, base = live_service
+    status, body, _ = _post(f"{base}/live/nobody/round", _wire_round(game))
+    assert status == 404 and "nobody" in body["error"]
+    # malformed: not JSON at all
+    req = urllib.request.Request(
+        f"{base}/live/acme/round", data=b"not json", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+    # malformed: JSON but not the wire shape
+    status, body, _ = _post(f"{base}/live/acme/round",
+                            {"deltas": [[1, 2]], "weights": "x"})
+    assert status == 400 and "malformed" in body["error"]
+    assert game.rounds_resident == 0
+
+
+def test_tenant_tokens_are_path_bound(live_service, monkeypatch):
+    svc, game, base = live_service
+    monkeypatch.setenv("MPLC_TPU_METRICS_TOKEN", "master-secret")
+    acme_tok = obs_export.tenant_token("master-secret", "acme")
+    beta_tok = obs_export.tenant_token("master-secret", "beta")
+    doc = _wire_round(game, seed=63)
+
+    # no credential / garbage credential: denied
+    assert _post(f"{base}/live/acme/round", doc)[0] == 401
+    assert _post(f"{base}/live/acme/round", doc, token="nope")[0] == 401
+    # tenant B's valid credential cannot write into A's game, even
+    # claiming its own identity in the query string
+    status, _, _ = _post(f"{base}/live/acme/round?tenant=beta", doc,
+                         token=beta_tok)
+    assert status == 401
+    assert game.rounds_resident == 0
+    # the right tenant's credential and the operator master both land
+    status, ack, _ = _post(f"{base}/live/acme/round?tenant=acme", doc,
+                           token=acme_tok)
+    assert status == 200 and ack["rounds_resident"] == 1
+    status, ack, _ = _post(f"{base}/live/acme/round", doc,
+                           token="master-secret")
+    assert status == 200 and ack["rounds_resident"] == 2
+
+
+def test_full_game_429_with_retry_after(live_service):
+    svc, _, base = live_service
+    capped = svc.live_game(_scenario_3p(seed=61), tenant="capped",
+                           max_rounds=1)
+    doc = _wire_round(capped, seed=64)
+    assert _post(f"{base}/live/capped/round", doc)[0] == 200
+    status, body, headers = _post(f"{base}/live/capped/round", doc)
+    assert status == 429
+    assert "MPLC_TPU_LIVE_MAX_ROUNDS" in body["error"]
+    assert body["retry_after_sec"] == 0.0
+    assert headers["Retry-After"] == "1"  # floored at the header's 1 s
+    assert capped.rounds_resident == 1

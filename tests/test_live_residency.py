@@ -1,0 +1,224 @@
+"""Bounded residency for the live tier (mplc_tpu/live/residency.py).
+
+The contract under test:
+
+1. **Eviction is a latency tier, not a correctness change.** For every
+   live query method (exact, GTG-Shapley, SVARM), evict -> restore ->
+   query is BIT-identical to the never-evicted answer: the WAL journals
+   each round exactly (json repr round-trip), so the restored stack —
+   and everything derived from it — is the same arrays.
+2. **LRU under the cap.** With `max_resident` games resident, admitting
+   one more evicts the least-recently-USED journaled game (touches
+   reorder the queue); journal-less games are never evicted (their
+   history only exists in RAM).
+3. **Admission refusal carries a backoff hint.** When no victim is
+   evictable, creating a new game raises `LiveResidencyFull` with a
+   `retry_after_sec` hint (p50 of recent restore latencies), same shape
+   as `ServiceOverloaded`; an ALREADY-resident game is never refused.
+4. **Kill -> restart with a mixed population.** A fresh process (fresh
+   LiveGames on the same WALs) answers identically whether the old
+   game died resident or evicted — the stub's WAL is as good as RAM.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from helpers import build_scenario, cluster_mlp_dataset
+from mplc_tpu.live import (LiveGame, LiveGameFull, LiveResidencyFull,
+                           residency)
+
+
+def _scenario_3p(seed=3):
+    return build_scenario(
+        partners_count=3, amounts_per_partner=[0.2, 0.3, 0.5],
+        dataset=cluster_mlp_dataset(n=240, seed=9, scale=1.0),
+        epoch_count=2, minibatch_count=2, seed=seed)
+
+
+def _synth_rounds(game, k, seed=0, scale=0.08):
+    rng = np.random.default_rng(seed)
+    P = game.engine.partners_count
+    rounds = []
+    for _ in range(k):
+        deltas = jax.tree_util.tree_map(
+            lambda l: rng.normal(0, scale, (P,) + l.shape).astype(l.dtype),
+            game._init_params)
+        w = rng.dirichlet(np.ones(P)).astype(np.float32)
+        rounds.append((deltas, w))
+    return rounds
+
+
+@pytest.fixture(autouse=True)
+def _isolated_residency():
+    """Each test starts and ends with clean process-wide books (other
+    test modules create games that would otherwise linger as entries)."""
+    residency.reset()
+    yield
+    residency.reset()
+
+
+@pytest.fixture(scope="module")
+def scen3():
+    return _scenario_3p()
+
+
+# ---------------------------------------------------------------------------
+# 1. evict -> restore -> query bit-identity, per method
+# ---------------------------------------------------------------------------
+
+def test_evict_restore_query_bit_identity_all_methods(scen3, tmp_path):
+    game = LiveGame(scen3, journal_path=str(tmp_path / "wal.jsonl"))
+    for deltas, w in _synth_rounds(game, 2, seed=41):
+        game.append_round(deltas, w)
+    gtg_kw = dict(sv_accuracy=1.0, min_iter=8, perm_batch=4)
+    svarm_kw = dict(budget=64, block=16)
+    before = {
+        "exact": game.query("exact").scores,
+        "GTG-Shapley": game.query("GTG-Shapley", **gtg_kw).scores,
+        "SVARM": game.query("SVARM", **svarm_kw).scores,
+    }
+    stamp, rounds = game.round_stamp, game.rounds_resident
+
+    assert game.evict() is True
+    assert not game.resident
+    assert game.rounds_resident == 0  # the stub holds no rounds
+    # the query restores through the WAL, then answers bit-identically
+    after_exact = game.query("exact")
+    assert game.resident
+    assert (game.round_stamp, game.rounds_resident) == (stamp, rounds)
+    assert after_exact.scores.tobytes() == before["exact"].tobytes()
+    for method, kw in (("GTG-Shapley", gtg_kw), ("SVARM", svarm_kw)):
+        game.evict()
+        r = game.query(method, **kw)
+        assert r.scores.tobytes() == before[method].tobytes(), method
+    assert residency.stats()["restores"] == 3
+    assert game.last_restore_s > 0.0
+    game.close()
+
+
+def test_journal_less_game_is_unevictable(scen3):
+    game = LiveGame(scen3)
+    game.append_round(*_synth_rounds(game, 1, seed=42)[0])
+    assert game.evict() is False  # nothing durable to restore from
+    assert game.resident and game.rounds_resident == 1
+    game.close()
+
+
+def test_describe_reports_residency_without_restoring(scen3, tmp_path):
+    game = LiveGame(scen3, journal_path=str(tmp_path / "wal.jsonl"))
+    game.append_round(*_synth_rounds(game, 1, seed=43)[0])
+    assert game.describe()["resident"] is True
+    game.evict()
+    d = game.describe()
+    # an observability read must never trigger a WAL replay
+    assert d["resident"] is False and not game.resident
+    assert d["rounds_resident"] == 0
+    game.close()
+
+
+# ---------------------------------------------------------------------------
+# 2. the LRU under a cap
+# ---------------------------------------------------------------------------
+
+def test_lru_evicts_coldest_journaled_game(scen3, tmp_path):
+    residency.configure(2)
+    g1 = LiveGame(scen3, tenant="t1", journal_path=str(tmp_path / "1.wal"))
+    g2 = LiveGame(scen3, tenant="t2", journal_path=str(tmp_path / "2.wal"))
+    for g, seed in ((g1, 1), (g2, 2)):
+        g.append_round(*_synth_rounds(g, 1, seed=seed)[0])
+    # touch g1 so g2 is now the least-recently-used
+    g1.query("exact")
+    g3 = LiveGame(scen3, tenant="t3", journal_path=str(tmp_path / "3.wal"))
+    assert g3.resident and g1.resident and not g2.resident
+    st = residency.stats()
+    assert st["max_resident"] == 2
+    assert st["resident"] == 2 and st["evicted"] == 1
+    assert st["evictions"] == 1
+    # touching the evicted game restores it, pushing out the new coldest
+    g2.query("exact")
+    assert g2.resident and not g1.resident
+    assert residency.stats()["restores"] == 1
+    for g in (g1, g2, g3):
+        g.close()
+    assert residency.stats()["resident"] == 0
+
+
+def test_cap_refuses_new_games_with_retry_hint(scen3):
+    residency.configure(1)
+    g1 = LiveGame(scen3, tenant="pinned")  # journal-less: unevictable
+    g1.append_round(*_synth_rounds(g1, 1, seed=44)[0])
+    residency.note_restore(0.25)  # seed the hint window
+    with pytest.raises(LiveResidencyFull,
+                       match="MPLC_TPU_LIVE_MAX_RESIDENT") as ei:
+        LiveGame(scen3, tenant="newcomer")
+    assert ei.value.retry_after_sec == pytest.approx(0.25)
+    assert isinstance(ei.value, LiveGameFull)  # one catch for both caps
+    # the resident game is never refused: the cap throttles growth only
+    g1.append_round(*_synth_rounds(g1, 1, seed=45)[0])
+    assert g1.query("exact").rounds == 2
+    g1.close()
+
+
+def test_live_game_full_carries_retry_after_sec(scen3):
+    game = LiveGame(scen3, max_rounds=1)
+    rounds = _synth_rounds(game, 2, seed=46)
+    game.append_round(*rounds[0])
+    with pytest.raises(LiveGameFull) as ei:
+        game.append_round(*rounds[1])
+    # the round-cap refusal rides the same backoff-hint shape as
+    # ServiceOverloaded and LiveResidencyFull
+    assert ei.value.retry_after_sec == 0.0
+    game.close()
+
+
+def test_retry_after_sec_is_nearest_rank_p50():
+    for s in (0.4, 0.1, 0.2, 0.3):
+        residency.note_restore(s)
+    assert residency.retry_after_sec() == pytest.approx(0.2)
+    assert residency.stats()["last_restore_s"] == pytest.approx(0.3)
+
+
+# ---------------------------------------------------------------------------
+# 4. kill -> restart over a mixed resident/evicted population
+# ---------------------------------------------------------------------------
+
+def test_kill_restart_with_mixed_resident_and_evicted_games(tmp_path):
+    sc = _scenario_3p()
+    wal_a = str(tmp_path / "a.wal")
+    wal_b = str(tmp_path / "b.wal")
+    ga = LiveGame(sc, tenant="a", journal_path=wal_a)
+    gb = LiveGame(sc, tenant="b", journal_path=wal_b)
+    for g, seed in ((ga, 47), (gb, 48)):
+        for deltas, w in _synth_rounds(g, 2, seed=seed):
+            g.append_round(deltas, w)
+    ra = ga.query("exact")
+    rb = gb.query("exact")
+    ga.evict()  # the "kill" catches a at the stub, b resident
+    ga.close()
+    gb.close()
+
+    residency.reset()
+    sc2 = _scenario_3p()
+    ga2 = LiveGame(sc2, tenant="a", journal_path=wal_a)
+    gb2 = LiveGame(sc2, tenant="b", journal_path=wal_b)
+    assert ga2.rounds_resident == 2 and gb2.rounds_resident == 2
+    np.testing.assert_array_equal(ga2.query("exact").scores, ra.scores)
+    np.testing.assert_array_equal(gb2.query("exact").scores, rb.scores)
+    ga2.close()
+    gb2.close()
+
+
+def test_residency_cap_env_knob(scen3, tmp_path, monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_LIVE_MAX_RESIDENT", "1")
+    assert residency.max_resident() == 1
+    g1 = LiveGame(scen3, journal_path=str(tmp_path / "e1.wal"))
+    g1.append_round(*_synth_rounds(g1, 1, seed=49)[0])
+    g2 = LiveGame(scen3, journal_path=str(tmp_path / "e2.wal"))
+    assert g2.resident and not g1.resident
+    # configure() overrides the env read (the bench/test hook)
+    residency.configure(0)
+    assert residency.max_resident() == 0  # unbounded again
+    g1.close()
+    g2.close()
