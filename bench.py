@@ -63,6 +63,19 @@ Configs (select with BENCH_CONFIG, default "1"):
      bit-identical across commits (MPLC_TPU_LIVE_MAX_RESIDENT applies
      when set; the emitted metric is p99 fresh-query seconds at max
      pressure)
+  11 fleet router chaos (mplc_tpu/service/router.py): BENCH_ROUTER_JOBS
+     mixed-shape jobs (default 8) routed through a FleetRouter fronting
+     BENCH_ROUTER_SHARDS inline SweepService shards (default 2, sliced
+     quanta so jobs span many scheduling turns) while the router's own
+     fault plan (MPLC_TPU_ROUTER_FAULT_PLAN, default
+     shardkill@shard0:sec2) kills a shard mid-run — measures the routed
+     wall-clock and the failover machinery end to end: the sidecar's
+     router block carries routed/resubmit/re-pin/failover/exhausted
+     totals and routing-latency quantiles, and the run equality-checks
+     the router invariant (every routed job terminal, completed v(S)
+     tables bit-identical to solo fault-free runs, failover exercised
+     when a kill was planned). MPLC_TPU_ROUTER_BUDGET / _BACKOFF_SEC /
+     _REPIN_OVERLOADS apply
 
 Workload notes. The reference (saved_experiments results.csv) trains ONE
 fedavg MNIST model in ~589 s wall-clock at 50 epochs and needs one full
@@ -268,6 +281,9 @@ _WORKLOAD_KNOBS = (
     # the fleet knobs reshape the fleet bench's process topology (shard
     # count) and wire the process into a shared cross-shard state dir
     "MPLC_TPU_FLEET_SHARDS", "MPLC_TPU_FLEET_SHARD_ID",
+    # the staleness window decides WHEN a router declares a silent shard
+    # dead (and so when failover work lands inside the timed region)
+    "MPLC_TPU_FLEET_STALE_SEC",
     "MPLC_TPU_FLEET_STATE_DIR",
     "MPLC_TPU_GTG_TRUNCATION",
     # the live-tier knobs change which coalitions a live query evaluates
@@ -290,12 +306,24 @@ _WORKLOAD_KNOBS = (
     # planner knobs change WHICH estimator a method="auto" query runs
     "MPLC_TPU_PLANNER_ACCURACY", "MPLC_TPU_PLANNER_DEADLINE_SEC",
     "MPLC_TPU_PRECISION", "MPLC_TPU_RECON_KERNEL",
-    "MPLC_TPU_RETRY_BACKOFF_SEC", "MPLC_TPU_SEED_ENSEMBLE",
+    "MPLC_TPU_RETRY_BACKOFF_SEC",
+    # the router knobs reshape config 11's chaos workload: how many
+    # redirects a job may spend, how long it backs off, when a sticky
+    # pin breaks, which shard dies when, and whether the routed HTTP
+    # surface is even served
+    "MPLC_TPU_ROUTER_BACKOFF_SEC", "MPLC_TPU_ROUTER_BUDGET",
+    "MPLC_TPU_ROUTER_FAULT_PLAN", "MPLC_TPU_ROUTER_REPIN_OVERLOADS",
+    "MPLC_TPU_ROUTER_SERVE",
+    "MPLC_TPU_SEED_ENSEMBLE",
     # the service knobs reshape the multi-tenant workload (injected
     # faults incl. chaos mode, slice granularity, admission bounds,
     # worker-pool concurrency, priority weighting, shed threshold)
     "MPLC_TPU_SERVICE_FAULT_PLAN", "MPLC_TPU_SERVICE_MAX_PENDING",
-    "MPLC_TPU_SERVICE_PRIORITY_DEFAULT", "MPLC_TPU_SERVICE_SHED_P99_SEC",
+    "MPLC_TPU_SERVICE_PRIORITY_DEFAULT",
+    # the retry floor reshapes every backoff the harness obeys (a higher
+    # floor throttles the submission loop itself)
+    "MPLC_TPU_SERVICE_RETRY_FLOOR_SEC",
+    "MPLC_TPU_SERVICE_SHED_P99_SEC",
     "MPLC_TPU_SERVICE_SLICE", "MPLC_TPU_SERVICE_WORKERS",
     "MPLC_TPU_SLOT_MERGE", "MPLC_TPU_SLOT_POW2",
     "MPLC_TPU_STEP_WIDTH_MULT", "MPLC_TPU_SVARM_SAMPLES",
@@ -1084,6 +1112,143 @@ def bench_load(epochs, dtype):
     _emit(metric, elapsed, 0.0)
 
 
+def bench_router(epochs, dtype):
+    """Config 11: the fleet-router chaos bench (module docstring). The
+    timed quantity is the whole routed run — submission through the
+    router's pick/redirect/backoff core, inline shard scheduling, the
+    mid-run shard kill, journal-replay failover, drain — and the
+    headline artifacts are the sidecar's router block (routing totals +
+    latency quantiles) and the equality-checked router invariant
+    (dtype is irrelevant: 1-epoch titanic logregs; the routing and
+    failover machinery is what's measured)."""
+    import importlib
+
+    from mplc_tpu import faults
+    from mplc_tpu.contrib.shapley import powerset_order
+    from mplc_tpu.obs import trace as obs_trace
+    from mplc_tpu.obs.report import sweep_report
+    from mplc_tpu.service import FleetRouter, RoutedJobFailed, SweepService
+
+    scripts_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "scripts")
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    load_gen = importlib.import_module("load_gen")
+
+    jobs = int(os.environ.get("BENCH_ROUTER_JOBS", "8"))
+    shards = int(os.environ.get("BENCH_ROUTER_SHARDS", "2"))
+    plan = (os.environ.get(faults.ROUTER_FAULT_PLAN_ENV)
+            or "shardkill@shard0:sec2")
+    print(f"[bench] router: {jobs} jobs over {shards} inline shards, "
+          f"plan={plan}", file=sys.stderr, flush=True)
+
+    games = [(p, s) for p in (2, 3) for s in (0, 1)]
+    services = {f"s{i}": SweepService(start=False, slice_coalitions=2)
+                for i in range(shards)}
+    router = FleetRouter(shards=services, fault_plan=plan,
+                         backoff_sec=0.01)
+    handles = []
+    failed_routes = 0
+    t0 = time.perf_counter()
+    with obs_trace.collect() as recs:
+        for i in range(jobs):
+            p, s = games[i % len(games)]
+            spec = {"partners": p, "seed": s, "epochs": 1,
+                    "dataset": "titanic"}
+            sc = load_gen.scenario_from_spec(spec)
+            _beat()
+            try:
+                handles.append(
+                    (router.submit(sc, tenant=f"tier{i % 3}", spec=spec),
+                     p, s))
+            except RoutedJobFailed:
+                failed_routes += 1
+        while router.pump():
+            _beat()
+            if time.perf_counter() - t0 > 3000:
+                raise TimeoutError("router bench did not drain")
+    elapsed = time.perf_counter() - t0
+    router.close()
+    for svc in services.values():
+        svc.shutdown(drain=False)
+
+    refs = {}
+    outcomes, mismatched, stuck = {}, [], []
+    for h, p, s in handles:
+        outcomes[h.status] = outcomes.get(h.status, 0) + 1
+        if not h.done:
+            stuck.append(h.job_id)
+            continue
+        if h.status == "completed":
+            if (p, s) not in refs:
+                refs[(p, s)] = load_gen.solo_reference(
+                    lambda p=p, s=s: load_gen.scenario_from_spec(
+                        {"partners": p, "seed": s, "epochs": 1,
+                         "dataset": "titanic"}))
+                _beat()
+            vals = h.values() or {}
+            want = refs[(p, s)]
+            if [vals.get(sub) for sub in powerset_order(p)] != \
+                    [want[sub] for sub in powerset_order(p)]:
+                mismatched.append(h.job_id)
+    planned = len(faults.parse_router_fault_plan(plan))
+    invariant_holds = (not stuck and not mismatched
+                       and not failed_routes
+                       and (router.stats["failovers"] >= 1
+                            if planned else True))
+    rep = sweep_report(recs)
+
+    # the bit-identity digest: ONE fixed game's routed v(S) bits (the
+    # 3-partner seed-0 game, present in every run) — the router
+    # invariant says these bits never depend on which shard died, so CI
+    # diffing them against the committed baseline turns any failover
+    # value drift into a same-fingerprint numerics-gate failure
+    import hashlib
+
+    from mplc_tpu.obs import numerics as obs_num
+    digest_spec = {"partners": 3, "seed": 0, "epochs": 1,
+                   "dataset": "titanic"}
+    rep_handle = next((h for h, p, s in handles
+                       if (p, s) == (3, 0) and h.status == "completed"),
+                      None)
+    if rep_handle is not None:
+        fp = hashlib.sha256(json.dumps(
+            digest_spec, sort_keys=True).encode()).hexdigest()[:16]
+        led = obs_num.ValueLedger(fp, meta={"precision": "fp32"})
+        for s, v in (rep_handle.values() or {}).items():
+            if s:
+                led.record(s, float(v), source="routed")
+        _NUMERICS_SIDECAR["block"] = {
+            "engine_fingerprint": led.engine_fingerprint,
+            "reduction_mode": "routed",
+            "topology": None,
+            "part_shards": None,
+            "entries": len(led.entries),
+            "values": led.values_bits(),
+        }
+    print(f"[bench] router: {len(handles)} routed in {elapsed:.1f} s "
+          f"outcomes={outcomes} stats={router.stats} "
+          f"invariant_holds={invariant_holds}",
+          file=sys.stderr, flush=True)
+    if not invariant_holds:
+        print(f"[bench] INVARIANT VIOLATION: stuck={stuck} "
+              f"mismatched={mismatched} failed_routes={failed_routes}",
+              file=sys.stderr, flush=True)
+    metric = f"router_{jobs}jobs_{shards}shards_wallclock"
+    _write_telemetry({"metric": metric, "wallclock_s": elapsed,
+                      "devices": _ndev(),
+                      "invariant_holds": invariant_holds,
+                      "router": {**router.stats,
+                                 "jobs": len(handles),
+                                 "shards": shards,
+                                 "fault_plan": plan,
+                                 "outcomes": outcomes,
+                                 "route_s": (rep.get("router") or {}).get(
+                                     "route_s"),
+                                 "report_row": rep.get("router")}})
+    _emit(metric, elapsed, 0.0)
+
+
 def bench_live(epochs, dtype):
     """Config 8: the live contributivity tier. One grand-coalition
     recording seeds a RESIDENT LiveGame; its recorded rounds are then
@@ -1720,8 +1885,10 @@ def main():
         bench_fleet(epochs, dtype)
     elif config == "10":
         bench_residency(epochs, dtype)
+    elif config == "11":
+        bench_router(epochs, dtype)
     else:
-        raise SystemExit(f"unknown BENCH_CONFIG={config!r} (use 1-10)")
+        raise SystemExit(f"unknown BENCH_CONFIG={config!r} (use 1-11)")
 
     if _watchdog_fired.is_set():
         # The watchdog declared this run dead and its fallback child owns

@@ -337,12 +337,21 @@ LIVE_CLUSTER_TAU_ENV = "MPLC_TPU_LIVE_CLUSTER_TAU"
 #                                  scheduler defers then SHEDS lowest-
 #                                  tier never-started jobs with a
 #                                  classified JobShed. 0/unset = off.
+#   MPLC_TPU_SERVICE_RETRY_FLOOR_SEC
+#                                  floor under the retry_after_sec hint
+#                                  ServiceOverloaded/JobShed carry
+#                                  (0.05): with no queue-wait history
+#                                  the windowed p50 is absent and the
+#                                  hint would read 0.0 — an instruction
+#                                  to hammer submit immediately. 0
+#                                  restores the old behavior.
 SERVICE_MAX_PENDING_ENV = "MPLC_TPU_SERVICE_MAX_PENDING"
 SERVICE_SLICE_ENV = "MPLC_TPU_SERVICE_SLICE"
 SERVICE_FAULT_PLAN_ENV = "MPLC_TPU_SERVICE_FAULT_PLAN"
 SERVICE_WORKERS_ENV = "MPLC_TPU_SERVICE_WORKERS"
 SERVICE_PRIORITY_DEFAULT_ENV = "MPLC_TPU_SERVICE_PRIORITY_DEFAULT"
 SERVICE_SHED_P99_ENV = "MPLC_TPU_SERVICE_SHED_P99_SEC"
+SERVICE_RETRY_FLOOR_ENV = "MPLC_TPU_SERVICE_RETRY_FLOOR_SEC"
 
 # Numeric-truth plane (mplc_tpu/obs/numerics.py):
 #   MPLC_TPU_DETERMINISTIC_REDUCE  =1 replaces every aggregation's
@@ -511,12 +520,56 @@ def recon_kernel_mode() -> str:
 #                             aggregated /fleet/metrics + /fleet/varz
 #                             view (with MPLC_TPU_METRICS_TOKEN as the
 #                             operator credential)
+#   MPLC_TPU_FLEET_STALE_SEC  staleness bound for cluster_view (30): a
+#                             shard whose published state file is older
+#                             than this is flagged stale, dropped from
+#                             the live set and never recommended as the
+#                             least-loaded redirect target
 FLEET_SHARDS_ENV = "MPLC_TPU_FLEET_SHARDS"
 FLEET_STATE_DIR_ENV = "MPLC_TPU_FLEET_STATE_DIR"
 FLEET_SHARD_ID_ENV = "MPLC_TPU_FLEET_SHARD_ID"
 FLEET_RUN_ID_ENV = "MPLC_TPU_FLEET_RUN_ID"
 FLEET_COORD_TS_ENV = "MPLC_TPU_FLEET_COORD_TS"
 FLEET_PEERS_ENV = "MPLC_TPU_FLEET_PEERS"
+FLEET_STALE_SEC_ENV = "MPLC_TPU_FLEET_STALE_SEC"
+
+# Fleet router (mplc_tpu/service/router.py) — the redirect-acting front
+# over N service shards:
+#   MPLC_TPU_ROUTER_BUDGET           per-job routing budget (8): total
+#                                    submit attempts (first + resubmits
+#                                    after ServiceOverloaded/JobShed
+#                                    redirects) before the failure is
+#                                    surfaced classified as
+#                                    RoutedJobFailed — never silently
+#                                    dropped, never retried forever
+#   MPLC_TPU_ROUTER_BACKOFF_SEC      base of the capped exponential
+#                                    backoff between resubmits (0.05);
+#                                    each attempt sleeps
+#                                    max(retry_after hint,
+#                                    base * 2^(attempt-1)), capped at
+#                                    32x base
+#   MPLC_TPU_ROUTER_REPIN_OVERLOADS  consecutive overloads from a
+#                                    tenant's pinned shard before the
+#                                    router breaks stickiness and
+#                                    re-pins to another shard (3) — a
+#                                    deliberate, journaled event, since
+#                                    a re-pin costs a WAL restore of the
+#                                    tenant's resident state
+#   MPLC_TPU_ROUTER_FAULT_PLAN       router-level chaos plan:
+#                                    `shardkill@shard<N>:sec<F>` kills
+#                                    the named shard F seconds into the
+#                                    run (comma-separated entries)
+#   MPLC_TPU_ROUTER_SERVE            =1 grows the telemetry server the
+#                                    POST /router/submit and
+#                                    GET /router/job routes a ShardServer
+#                                    peer exposes; off by default — a
+#                                    MUTATING HTTP surface is an explicit
+#                                    operator decision
+ROUTER_BUDGET_ENV = "MPLC_TPU_ROUTER_BUDGET"
+ROUTER_BACKOFF_ENV = "MPLC_TPU_ROUTER_BACKOFF_SEC"
+ROUTER_REPIN_OVERLOADS_ENV = "MPLC_TPU_ROUTER_REPIN_OVERLOADS"
+ROUTER_FAULT_PLAN_ENV = "MPLC_TPU_ROUTER_FAULT_PLAN"
+ROUTER_SERVE_ENV = "MPLC_TPU_ROUTER_SERVE"
 
 
 _barrier_degradation_warned = False
@@ -683,6 +736,20 @@ ENV_KNOBS = {
     "MPLC_TPU_SERVICE_WORKERS": "workload",
     "MPLC_TPU_SERVICE_PRIORITY_DEFAULT": "workload",
     "MPLC_TPU_SERVICE_SHED_P99_SEC": "workload",
+    # the retry floor shapes every retrying client's backoff cadence (a
+    # routed overload run with floor 0 is a hammer loop, not the same
+    # workload), and the router knobs reshape the routed bench workload:
+    # budget decides which jobs survive at all, backoff paces the
+    # resubmit storm, the re-pin bound decides when stickiness breaks,
+    # the fault plan kills shards, and the serve gate opens the mutating
+    # routed-submit HTTP surface — none may leak into a cached replay or
+    # the CPU-fallback child
+    "MPLC_TPU_SERVICE_RETRY_FLOOR_SEC": "workload",
+    "MPLC_TPU_ROUTER_BUDGET": "workload",
+    "MPLC_TPU_ROUTER_BACKOFF_SEC": "workload",
+    "MPLC_TPU_ROUTER_REPIN_OVERLOADS": "workload",
+    "MPLC_TPU_ROUTER_FAULT_PLAN": "workload",
+    "MPLC_TPU_ROUTER_SERVE": "workload",
     "MPLC_TPU_PIPELINE_BATCHES": "workload",
     "MPLC_TPU_RETRY_BACKOFF_SEC": "workload",
     "MPLC_TPU_SLOT_MERGE": "workload",
@@ -702,6 +769,10 @@ ENV_KNOBS = {
     "MPLC_TPU_FLEET_SHARDS": "workload",
     "MPLC_TPU_FLEET_STATE_DIR": "workload",
     "MPLC_TPU_FLEET_SHARD_ID": "workload",
+    # the staleness bound decides which shards a routed run may target
+    # (a dead shard's window of false liveness), so it reshapes the
+    # routed workload the same way the state dir does
+    "MPLC_TPU_FLEET_STALE_SEC": "workload",
     # deterministic-reduce changes v(S) ITSELF (a pinned reduction order
     # is a different — bit-stable — game trajectory), and the audit
     # drains overlap + runs extra capture passes at fence ordinals, so

@@ -83,6 +83,7 @@ import warnings
 FAULT_PLAN_ENV = "MPLC_TPU_FAULT_PLAN"
 PARTNER_FAULT_PLAN_ENV = "MPLC_TPU_PARTNER_FAULT_PLAN"
 SERVICE_FAULT_PLAN_ENV = "MPLC_TPU_SERVICE_FAULT_PLAN"
+ROUTER_FAULT_PLAN_ENV = "MPLC_TPU_ROUTER_FAULT_PLAN"
 
 try:  # the concrete class jax raises for device/runtime failures
     from jaxlib.xla_extension import XlaRuntimeError as _XlaRuntimeError
@@ -561,6 +562,55 @@ def merge_service_entries(*entries) -> "dict | None":
 
 def service_fault_plan_from_env() -> dict:
     return parse_service_fault_plan(os.environ.get(SERVICE_FAULT_PLAN_ENV))
+
+
+# ---------------------------------------------------------------------------
+# Router-level chaos (MPLC_TPU_ROUTER_FAULT_PLAN) — shard-granular faults
+# the fleet router (service/router.py) injects into its OWN routing
+# table, the way the service plan above injects into one scheduler:
+#
+#   shardkill@shard1:sec5   kill the named shard 5 seconds into the run
+#                           (the router abandons the shard WITHOUT a
+#                           clean shutdown — its state file goes stale,
+#                           its journal keeps the incomplete jobs — and
+#                           failover must resubmit them elsewhere)
+#
+# The shard name matches a routing-table shard id exactly, or `shard<N>`
+# addresses the N-th shard (0-based) of the router's table — so a test
+# plan works against auto-generated `pid<...>` shard ids too. Times are
+# measured from FleetRouter construction (or its clock_reset()).
+
+_ROUTER_ENTRY_RE = re.compile(
+    r"^(shardkill)@([A-Za-z0-9_.-]+):sec([0-9]+(?:\.[0-9]+)?)$")
+
+
+def parse_router_fault_plan(spec: str | None) -> list:
+    """`[{"kind": "shardkill", "shard": str, "at_sec": float}, ...]`
+    sorted by fire time, from the router-plan grammar above. Malformed
+    entries warn and are dropped (a typo in a chaos plan must never
+    itself crash a routed run); empty/unset spec is the empty plan."""
+    plan: list = []
+    if not spec:
+        return plan
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        m = _ROUTER_ENTRY_RE.match(entry)
+        if m is None:
+            warnings.warn(
+                f"{ROUTER_FAULT_PLAN_ENV}: ignoring malformed entry "
+                f"{entry!r} (expected shardkill@<shard>:sec<F>)",
+                stacklevel=2)
+            continue
+        plan.append({"kind": m.group(1), "shard": m.group(2),
+                     "at_sec": float(m.group(3))})
+    plan.sort(key=lambda e: e["at_sec"])
+    return plan
+
+
+def router_fault_plan_from_env() -> list:
+    return parse_router_fault_plan(os.environ.get(ROUTER_FAULT_PLAN_ENV))
 
 
 def normalized_plan_repr(plan: dict) -> str:

@@ -94,11 +94,20 @@ METRICS_TOKEN_ENV = "MPLC_TPU_METRICS_TOKEN"
 # routes above.
 LIVE_INGEST_ENV = "MPLC_TPU_LIVE_INGEST"
 
+# Routed submission (the fleet router's HTTP peer surface): when set to
+# "1", the server grows `POST /router/submit` (one routed job
+# submission, fed to the registered ShardServer sink) and
+# `GET /router/job?id=` (terminal status + scores + the full v(S)
+# table). Off by default for the same reason as live ingestion: a
+# MUTATING HTTP surface is an explicit operator decision.
+ROUTER_SERVE_ENV = "MPLC_TPU_ROUTER_SERVE"
+
 _lock = threading.Lock()
 _server: "TelemetryServer | None" = None
 _health_providers: dict = {}
 _varz_providers: dict = {}
 _live_ingest_sinks: dict = {}
+_router_sinks: dict = {}
 
 
 # -- provider registry --------------------------------------------------------
@@ -128,11 +137,22 @@ def register_live_ingest(name: str, fn) -> None:
         _live_ingest_sinks[name] = fn
 
 
+def register_router(name: str, fn) -> None:
+    """Register a routed-submission sink (service/router.ShardServer):
+    `fn(op, payload)` handles `op="submit"` (one routed job wire
+    document -> ack) and `op="job"` (`{"job": id}` -> status document).
+    Same WeakMethod auto-unregister contract as the other registries;
+    the /router/* routes only exist when `MPLC_TPU_ROUTER_SERVE=1`."""
+    with _lock:
+        _router_sinks[name] = fn
+
+
 def unregister(name: str) -> None:
     with _lock:
         _health_providers.pop(name, None)
         _varz_providers.pop(name, None)
         _live_ingest_sinks.pop(name, None)
+        _router_sinks.pop(name, None)
 
 
 def _call_providers(providers: dict) -> dict:
@@ -176,6 +196,33 @@ def live_ingest(tenant: str, doc: dict) -> dict:
         raise last
     raise LookupError("no live ingestion sink registered (is a "
                       "SweepService running in this process?)")
+
+
+def router_dispatch(op: str, payload) -> dict:
+    """Dispatch one routed-submission operation to the registered
+    ShardServer sinks. Same contract shape as `live_ingest`: a sink
+    that doesn't know the job raises KeyError and the next is tried;
+    LookupError with no sink registered (503), the last KeyError when
+    none knows the job (404); everything else propagates for the
+    handler to classify (429/403/400)."""
+    with _lock:
+        sinks = dict(_router_sinks)
+    last: "KeyError | None" = None
+    for name, fn in sorted(sinks.items()):
+        if isinstance(fn, weakref.WeakMethod):
+            live = fn()
+            if live is None:
+                unregister(name)  # the owner was collected
+                continue
+            fn = live
+        try:
+            return fn(op, payload)
+        except KeyError as e:
+            last = e
+    if last is not None:
+        raise last
+    raise LookupError("no routed-submission sink registered (is a "
+                      "ShardServer running in this process?)")
 
 
 def health_view() -> tuple[bool, dict]:
@@ -619,6 +666,33 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             except Exception as e:  # collector failure is a 503, not 500
                 self._reply(503, json.dumps(
                     {"error": str(e)[:500]}).encode(), "application/json")
+        elif path == "/router/job":
+            # routed-job polling (the fleet router's result surface);
+            # gated like the submit route — the pair only exists
+            # together. Tenant-credentialed viewers may only read their
+            # OWN jobs: the v(S) table is the tenant's game data.
+            if os.environ.get(ROUTER_SERVE_ENV) != "1":
+                return self._reply(404, b"not found\n", "text/plain")
+            role, viewer = self._auth_role(query)
+            if role == "denied":
+                return self._deny()
+            job_id = urllib.parse.parse_qs(query).get("id", [None])[0]
+            if not job_id:
+                return self._reply(400, json.dumps(
+                    {"error": "missing ?id=<job_id>"}).encode(),
+                    "application/json")
+            try:
+                doc = router_dispatch("job", {"job": job_id})
+            except KeyError as e:
+                return self._reply(404, json.dumps(
+                    {"error": str(e)[:500]}).encode(), "application/json")
+            except LookupError as e:
+                return self._reply(503, json.dumps(
+                    {"error": str(e)[:500]}).encode(), "application/json")
+            if role == "tenant" and doc.get("tenant") != viewer:
+                return self._deny()
+            self._reply(200, json.dumps(doc, default=str).encode(),
+                        "application/json")
         elif path == "/":
             self._reply(200, b"mplc_tpu telemetry: /metrics /healthz "
                         b"/varz /fleet/metrics /fleet/varz\n",
@@ -626,8 +700,72 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         else:
             self._reply(404, b"not found\n", "text/plain")
 
+    def _router_submit(self) -> None:
+        """POST /router/submit — one routed job submission (the fleet
+        router's wire path into this shard's ShardServer sink). Error
+        mapping mirrors the service's submit contract: 429+Retry-After
+        for ServiceOverloaded/JobShed (body carries retry_after_sec,
+        the `kind`, and the cluster redirect hint), 403 for a failed
+        credential, 503 for a closed service / missing sink, 400 for a
+        malformed document."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            doc = json.loads(self.rfile.read(length).decode())
+            if not isinstance(doc, dict):
+                raise ValueError("submission must be a JSON object")
+        except Exception as e:
+            return self._reply(400, json.dumps(
+                {"error": f"bad request body: {str(e)[:300]}"}).encode(),
+                "application/json")
+        from ..service.scheduler import (JobShed, ServiceAuthError,
+                                         ServiceClosed, ServiceOverloaded)
+        try:
+            ack = router_dispatch("submit", doc)
+        except (ServiceOverloaded, JobShed) as e:
+            cluster = getattr(e, "cluster", None) or {}
+            body = json.dumps({
+                "error": str(e)[:500],
+                "kind": "shed" if isinstance(e, JobShed) else "overloaded",
+                "retry_after_sec": float(
+                    getattr(e, "retry_after_sec", 0.0) or 0.0),
+                # the redirect hint alone rides the wire — never the
+                # full view (its rows carry other shards' metrics)
+                "cluster": {"least_loaded": cluster.get("least_loaded")},
+            })
+            self.send_response(429)
+            self.send_header("Retry-After", str(max(1, int(float(
+                getattr(e, "retry_after_sec", 0.0) or 0.0) + 0.5))))
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body.encode())
+            return
+        except ServiceAuthError as e:
+            return self._reply(403, json.dumps(
+                {"error": str(e)[:500]}).encode(), "application/json")
+        except (ServiceClosed, LookupError) as e:
+            return self._reply(503, json.dumps(
+                {"error": str(e)[:500]}).encode(), "application/json")
+        except KeyError as e:
+            return self._reply(404, json.dumps(
+                {"error": str(e)[:500]}).encode(), "application/json")
+        except ValueError as e:
+            return self._reply(400, json.dumps(
+                {"error": str(e)[:500]}).encode(), "application/json")
+        except Exception as e:  # a sink crash is a 500 with evidence
+            return self._reply(500, json.dumps(
+                {"error": str(e)[:500]}).encode(), "application/json")
+        self._reply(200, json.dumps(ack, default=str).encode(),
+                    "application/json")
+
     def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
         path, _, query = self.path.partition("?")
+        if path == "/router/submit":
+            if os.environ.get(ROUTER_SERVE_ENV) != "1":
+                # same opt-in rule as live ingestion below: the
+                # mutating route doesn't exist unless asked for
+                return self._reply(404, b"not found\n", "text/plain")
+            return self._router_submit()
         m = _LIVE_ROUND_RE.match(path)
         if m is None or os.environ.get(LIVE_INGEST_ENV) != "1":
             # the mutating route doesn't EXIST unless the operator
@@ -746,6 +884,14 @@ def stop() -> None:
 
 def active_server() -> "TelemetryServer | None":
     return _server
+
+
+def active_port() -> "int | None":
+    """The singleton telemetry server's bound port (None with no server
+    up) — published in the fleet shard state file so a router can
+    discover each shard's HTTP surface through the state dir alone."""
+    srv = _server
+    return srv.port if srv is not None else None
 
 
 def maybe_start_from_env() -> "TelemetryServer | None":

@@ -57,7 +57,13 @@ quantities every perf PR needs as a measured before/after:
     `service.job` events, slice-duration p50/p95/p99 from the
     `service.slice` spans — plus deadline misses and re-queued attempts
     (`service.job_fault`), mirroring the live per-tenant histograms the
-    /metrics endpoint exports (obs/export.py).
+    /metrics endpoint exports (obs/export.py);
+  - a router row (fleet-router runs): jobs routed through the front,
+    redirect resubmits, sticky-pin breaks, shard failovers with the
+    journal-seeded jobs they resubmitted, budget exhaustions, and
+    end-to-end routing-latency quantiles from the `router.submit`
+    spans — mirroring the live `router.*` counters and the
+    `router.route_sec` histogram.
 
 The report is derived from SPANS of the collected region only, so callers
 get a clean per-run view without resetting the process-global metrics
@@ -154,6 +160,11 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
     costed_span_s = 0.0
     fenced_flops = fenced_flops_sec = 0.0
     roof: dict = {}                 # (slot_count, width) -> cost buckets
+    # fleet-router events (service/router.py): counts mirror the live
+    # router.* counters; route_durs mirrors the router.route_sec histogram
+    rtr = {"routed": 0, "resubmits": 0, "repins": 0, "failovers": 0,
+           "failover_jobs": 0, "budget_exhausted": 0}
+    rtr_route_durs: list = []
 
     for rec in records:
         name = rec.get("name")
@@ -323,6 +334,21 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
             # service.job_retries counter this row mirrors
             tn = a.get("tenant", "?")
             svc_job_faults[tn] = svc_job_faults.get(tn, 0) + 1
+        elif name == "router.submit":
+            rtr["routed"] += 1
+            # a zero-duration event whose route_s attr carries the
+            # measured submit->accept latency (redirects + backoff
+            # included), mirroring the router.route_sec histogram
+            rtr_route_durs.append(float(a.get("route_s") or dur))
+        elif name == "router.redirect":
+            rtr["resubmits"] += 1
+        elif name == "router.repin":
+            rtr["repins"] += 1
+        elif name == "router.failover":
+            rtr["failovers"] += 1
+            rtr["failover_jobs"] += int(a.get("resubmitted", 0))
+        elif name == "router.exhausted":
+            rtr["budget_exhausted"] += 1
         elif name == "numerics.audit":
             num_audits += 1
             num_max_ulp = max(num_max_ulp, int(a.get("max_ulp") or 0))
@@ -728,6 +754,16 @@ def sweep_report(records: list, metrics_snapshot: dict | None = None,
                 "retries": svc_job_faults.get(tn, 0),
             }
         report["slo"] = slo
+    if rtr["routed"] or rtr["resubmits"] or rtr["failovers"]:
+        # the fleet-router row: how the front spread work over shards and
+        # what it cost to keep jobs alive through redirects and deaths —
+        # runs without a router produce no row at all
+        report["router"] = {
+            **rtr,
+            "route_s": {"p50": _pctl(rtr_route_durs, 0.50),
+                        "p95": _pctl(rtr_route_durs, 0.95),
+                        "p99": _pctl(rtr_route_durs, 0.99)},
+        }
     if num_audits or num_drift or num_ledger is not None:
         # the numeric-truth row: reduction audits run, order divergences
         # localized (with the worst ulp distance), and the ledger's
@@ -874,6 +910,21 @@ def format_report(report: dict) -> str:
                 f"{_q(sl, 'p99')}s  "
                 f"deadline_misses={s['deadline_misses']}  "
                 f"retries={s['retries']}")
+    rt = report.get("router")
+    if rt is not None:
+        rq = rt.get("route_s") or {}
+
+        def _rq(k):
+            v = rq.get(k)
+            return f"{v:.3f}" if v is not None else "n/a"
+        lines.append(
+            f"  router      routed={rt['routed']}  "
+            f"resubmits={rt['resubmits']}  repins={rt['repins']}  "
+            f"failovers={rt['failovers']}"
+            + (f" (jobs={rt['failover_jobs']})"
+               if rt.get("failover_jobs") else "")
+            + f"  exhausted={rt['budget_exhausted']}  "
+            f"route p50/p95/p99={_rq('p50')}/{_rq('p95')}/{_rq('p99')}s")
     lv = report.get("live")
     if lv is not None:
         q = lv.get("query_s") or {}

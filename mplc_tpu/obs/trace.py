@@ -135,6 +135,23 @@ SPAN_REGISTRY = {
     "live.ingest": "one wire round accepted via POST /live/<tenant>/"
                    "round (attrs: tenant/stamp/rounds)",
     "service.journal_broken": "WAL append failure (journaling disabled)",
+    "service.auth_reject": "submit-path credential check failed (attrs: "
+                           "tenant) — a synchronous auth error, never a "
+                           "quarantine",
+    "router.submit": "one job routed end-to-end by the fleet router "
+                     "(attrs: tenant/job/shard/attempts/route_s)",
+    "router.redirect": "one overload/shed redirect followed (attrs: "
+                       "tenant/job/from/to/attempt/retry_after_sec)",
+    "router.repin": "a tenant's sticky shard pin deliberately broken "
+                    "(attrs: tenant/from/to/reason=death|overload)",
+    "router.failover": "a dead shard drained from the routing table and "
+                       "its journaled incomplete jobs resubmitted "
+                       "(attrs: shard/jobs/resubmitted)",
+    "router.exhausted": "a job's routing budget ran out — failure "
+                        "surfaced classified as RoutedJobFailed (attrs: "
+                        "tenant/job/attempts/budget)",
+    "router.fault": "router-level chaos plan entry fired (attrs: kind/"
+                    "shard/at_sec)",
     "flight.dump": "flight-recorder postmortem written (attrs: reason/"
                    "path)",
     "numerics.audit": "per-device reduction audit of one coalition "

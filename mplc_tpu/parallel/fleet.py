@@ -1010,16 +1010,24 @@ def publish_shard_state(state_dir: str, shard_id: str,
                 "without logging", state_dir, e)
 
 
-def cluster_view(state_dir: str, stale_sec: float = 30.0,
+def cluster_view(state_dir: str, stale_sec: "float | None" = None,
                  include_metrics: bool = False) -> dict:
     """Aggregate every shard's published state: per-shard rows (stale
     ones flagged, not dropped — a wedged shard's last word is evidence)
     plus cluster totals the admission governor and /healthz expose.
     `least_loaded` names the live shard with the shallowest queue — the
-    redirect hint an overloaded shard hands back to fleet routers. A
-    shard that published `closed: true` (shutting down — it may still
-    be draining, but accepts nothing) is excluded from the live set, so
-    a router is never redirected at a closing service."""
+    redirect hint an overloaded shard hands back to fleet routers; a
+    STALE shard (state file older than `stale_sec`, default
+    `MPLC_TPU_FLEET_STALE_SEC` or 30 s) is excluded from the live set
+    and can never be recommended — a dead shard's last published queue
+    depth was probably 0, which is exactly the bait a naive
+    least-loaded rule would take. A shard that published `closed: true`
+    (shutting down — it may still be draining, but accepts nothing) is
+    excluded the same way, so a router is never redirected at a closing
+    service."""
+    if stale_sec is None:
+        from .. import constants as _c
+        stale_sec = _c._env_nonneg_float(_c.FLEET_STALE_SEC_ENV, 30.0)
     shards = {}
     now = time.time()
     try:

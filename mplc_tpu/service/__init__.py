@@ -1,23 +1,30 @@
 """Sweep-as-a-service: fault-isolated multi-tenant sweep scheduling with
 a device-pinned worker pool and journaled crash recovery (scheduler.py),
 SLO-driven admission control, priority tiers and load shedding
-(admission.py), cross-tenant program packing bookkeeping (packer.py) and
-the checksummed write-ahead journal (journal.py)."""
+(admission.py), cross-tenant program packing bookkeeping (packer.py),
+the checksummed write-ahead journal (journal.py) and the fleet router —
+the redirect-acting, tenant-sticky front over N service shards with
+shard failover (router.py)."""
 
 from .admission import AdmissionController, TierQueue
 from .journal import JournalCorruptError, SweepJournal
 from .packer import CrossTenantPacker
+from .router import FleetRouter, RoutedJobFailed
 from .scheduler import (JobCancelled, JobQuarantined, JobShed,
-                        ServiceClosed, ServiceError, ServiceOverloaded,
-                        ServiceRejected, SweepJob, SweepService)
+                        ServiceAuthError, ServiceClosed, ServiceError,
+                        ServiceOverloaded, ServiceRejected, SweepJob,
+                        SweepService)
 
 __all__ = [
     "AdmissionController",
     "CrossTenantPacker",
+    "FleetRouter",
     "JobCancelled",
     "JobQuarantined",
     "JobShed",
     "JournalCorruptError",
+    "RoutedJobFailed",
+    "ServiceAuthError",
     "ServiceClosed",
     "ServiceError",
     "ServiceOverloaded",

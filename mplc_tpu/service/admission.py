@@ -38,9 +38,11 @@ driven by the live queue-wait SLO signal the scheduler already measures:
   `service.jobs_shed` — separate from rejected/cancelled/quarantined,
   and never silent) until the queue is back to half the admission bound.
   Shed errors and `ServiceOverloaded` both carry a `retry_after_sec`
-  hint — the windowed queue-wait p50 (0.0 with no history) — so callers
-  back off for roughly one queue's worth of time instead of hammering
-  `submit` in a tight loop.
+  hint — the windowed queue-wait p50, floored at
+  `MPLC_TPU_SERVICE_RETRY_FLOOR_SEC` (default 0.05; without the floor a
+  no-history hint of 0.0 tells a retrying client to hammer immediately)
+  — so callers back off for roughly one queue's worth of time instead
+  of hammering `submit` in a tight loop.
 
   The controller is deliberately *windowed*, not cumulative: the SLO
   histograms (obs/metrics.py) never forget, so a single overload spike
@@ -70,6 +72,8 @@ from __future__ import annotations
 import math
 import time
 from collections import deque
+
+from .. import constants
 
 
 def nearest_rank(samples, q: float) -> "float | None":
@@ -186,6 +190,12 @@ class AdmissionController:
         self.defer_dwell_sec = (float(defer_dwell_sec)
                                 if defer_dwell_sec is not None
                                 else 0.1 * self.shed_p99_sec)
+        # floor under the retry hint: a fresh (or long-idle) service has
+        # no queue-wait history, and a 0.0 hint is an instruction to
+        # retry in a tight loop — resolved once at construction so a
+        # governor's contract can't drift mid-run
+        self.retry_floor_sec = constants._env_nonneg_float(
+            constants.SERVICE_RETRY_FLOOR_ENV, 0.05)
         self._waits: deque = deque(maxlen=window)  # (monotonic ts, wait)
         self.state = self.HEALTHY
         self.shed_total = 0
@@ -257,9 +267,11 @@ class AdmissionController:
     def retry_after_sec(self) -> float:
         """The backoff hint carried by `ServiceOverloaded` and `JobShed`:
         the windowed queue-wait p50 — roughly one queue's worth of
-        patience — or 0.0 when no job has ever been scheduled."""
+        patience — floored at `retry_floor_sec` (a no-history hint of
+        0.0 would tell a retrying client to hammer immediately)."""
         p50 = nearest_rank(self._recent_waits(), 0.50)
-        return float(p50) if p50 is not None else 0.0
+        return max(float(p50) if p50 is not None else 0.0,
+                   self.retry_floor_sec)
 
     # -- observability ---------------------------------------------------
 

@@ -1,0 +1,1090 @@
+"""Fleet router — the redirect-ACTING, tenant-sticky front over N
+`SweepService` shards.
+
+The fleet plane (parallel/fleet.py) gives every shard a published state
+file and every `ServiceOverloaded` a least-loaded redirect hint, but
+nothing in the tree ACTS on them: each admission governor sheds only its
+own queue, a client that hits backpressure is on its own, and a dead
+shard strands its journaled jobs until an operator replays the WAL by
+hand. This module is the missing client-facing half:
+
+  **Redirects, acted on.** `FleetRouter.submit` resubmits on
+  `ServiceOverloaded`/`JobShed`, following the error's cluster redirect
+  hint (`least_loaded`) with capped exponential backoff that honors the
+  error's own `retry_after_sec`. The loop is bounded by a per-job
+  routing budget (`MPLC_TPU_ROUTER_BUDGET`, default 8 total attempts)
+  after which the failure surfaces as a classified `RoutedJobFailed` —
+  never a silent drop, never an unbounded retry storm.
+
+  **Tenant stickiness.** A tenant's resident `LiveGame` and banked
+  programs live on ONE shard; routing its next job elsewhere forfeits
+  the residency the live tier paid for. The router therefore pins each
+  tenant to the shard that last accepted its work and keeps routing
+  there, breaking the pin only on shard death or on
+  `MPLC_TPU_ROUTER_REPIN_OVERLOADS` CONSECUTIVE overloads from the
+  pinned shard — a deliberate, journaled re-pin (`router.repin`), since
+  a re-pin costs the tenant a WAL restore on the new shard.
+
+  **Cluster-wide shed coordination.** Each shard's published state
+  carries its admission-governor state. The router stops OFFERING new
+  work to deferring/shedding shards while any healthy shard remains, so
+  per-shard load shedding becomes fleet-level graceful degradation: the
+  governor that would have shed never sees the work. When every shard
+  is unhealthy the router degrades to least-loaded among the living —
+  refusing all work would turn an overload into an outage.
+
+  **Failover.** A shard whose published heartbeat goes stale
+  (`cluster_view` staleness bound), whose `/healthz` flips 503/
+  unreachable, or which a chaos plan kills is drained from the routing
+  table. Its journaled incomplete jobs are resubmitted to surviving
+  shards through the EXISTING recovered-jobs/WAL-seeding path: the
+  router replays the dead shard's journal (`SweepJournal.replay`),
+  hands each job's harvested `{subset: value}` map to the survivor via
+  `SweepService.adopt_recovered`, and resubmits under the old job id —
+  `_build_engine` seeds the fresh engine's memo from those values, so a
+  failed-over job's completed v(S) table is BIT-IDENTICAL to a solo
+  fault-free run (the PR-11 overload invariant, now under shard-kill
+  chaos) and only never-harvested coalitions train again.
+
+Two shard flavors share one routing core:
+
+  `InProcShard` — wraps a `SweepService` in this process (the
+  deterministic test/bench harness; inline `start=False` services are
+  advanced by `FleetRouter.pump`). A killed in-proc shard is ABANDONED,
+  not shut down: its journal file stays exactly as a SIGKILL would
+  leave it, which is what failover replays.
+
+  `HttpShard` — a peer process discovered through the shared fleet
+  state dir (each shard publishes its telemetry port and journal path
+  in its state file). Submission goes over `POST /router/submit` on the
+  peer's telemetry server (`ShardServer` + obs/export.py, gated on
+  `MPLC_TPU_ROUTER_SERVE=1`); results are polled via
+  `GET /router/job?id=`. When `MPLC_TPU_METRICS_TOKEN` is set the wire
+  REQUIRES the per-tenant HMAC credential — the in-process embedder is
+  trusted, the network is not.
+
+Chaos: `MPLC_TPU_ROUTER_FAULT_PLAN` (faults.py) kills shards on a
+schedule — `shardkill@shard1:sec5` — so the failover path is a routine,
+deterministically exercised code path rather than an emergency one.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+import weakref
+from urllib.parse import quote as _urlquote
+
+from .. import constants, faults
+from ..obs import export as obs_export
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from .journal import SweepJournal
+from .scheduler import (JobShed, ServiceAuthError, ServiceClosed,
+                        ServiceError, ServiceOverloaded)
+
+logger = logging.getLogger("mplc_tpu")
+
+# capped exponential backoff: attempt k sleeps
+# max(retry_after hint, base * 2^(k-1)) bounded at base * _BACKOFF_CAP_MULT
+_BACKOFF_CAP_MULT = 32.0
+# liveness probes (healthz / cluster view) are rate-limited per shard so
+# a tight routing loop never turns into a tight HTTP/stat loop
+_PROBE_INTERVAL_SEC = 0.5
+_HTTP_TIMEOUT_SEC = 10.0
+
+
+class RoutedJobFailed(ServiceError):
+    """The routing budget ran out (or no live shard remained) before any
+    shard accepted the job. A CLASSIFIED terminal outcome — counted in
+    `router.budget_exhausted`, journaled on the `router.exhausted`
+    event, `__cause__` carrying the last shard error — never a silent
+    drop. Nothing about the job itself is wrong; resubmit when the
+    cluster has capacity."""
+
+    def __init__(self, msg: str, tenant: "str | None" = None,
+                 job_id: "str | None" = None, attempts: int = 0):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.job_id = job_id
+        self.attempts = int(attempts)
+
+
+class ShardUnavailable(ServiceError):
+    """Internal routing signal: the targeted shard is dead/unreachable
+    at submit time (connection refused, closed service, killed handle).
+    Routing treats it like a failed attempt and excludes the shard; it
+    never escapes `FleetRouter.submit`."""
+
+
+# ---------------------------------------------------------------------------
+# shard handles
+# ---------------------------------------------------------------------------
+
+class InProcShard:
+    """One in-process `SweepService` behind the router — the
+    deterministic harness the chaos tests and BENCH_CONFIG=11 drive.
+    `kill()` ABANDONS the service (no shutdown, no journal close): the
+    WAL on disk is exactly what a SIGKILL would leave, which is what
+    failover replays."""
+
+    kind = "inproc"
+
+    def __init__(self, shard_id: str, service):
+        self.shard_id = str(shard_id)
+        self.service = service
+        self.dead = False
+        self._drained = False  # failover ran for this shard
+
+    @property
+    def journal_path(self) -> "str | None":
+        j = self.service._journal
+        return j.path if j is not None else None
+
+    def admission_state(self) -> str:
+        return self.service._admission.state
+
+    def queue_depth(self) -> int:
+        return len(self.service._queue)
+
+    def closed(self) -> bool:
+        return bool(self.service._closed)
+
+    def submit(self, req: dict, recover: "dict | None" = None):
+        if self.dead:
+            raise ShardUnavailable(
+                f"shard {self.shard_id!r} is dead")
+        if recover is not None:
+            self._adopt(recover, req)
+        return self.service.submit(
+            req["scenario"], method=req["method"], tenant=req["tenant"],
+            deadline_sec=req.get("deadline_sec"), job_id=req["job_id"],
+            priority=req.get("priority"),
+            credential=req.get("credential"))
+
+    def _adopt(self, recover: dict, req: dict) -> None:
+        try:
+            self.service.adopt_recovered(
+                req["job_id"], tenant=req["tenant"], method=req["method"],
+                partners_count=recover.get("partners_count"),
+                values=recover.get("values") or {})
+        except ValueError:
+            # an earlier routing attempt already adopted these values on
+            # this shard (then hit backpressure): the seed is identical,
+            # adoption is idempotent by construction
+            pass
+
+    def job_status(self, job_id: str) -> dict:
+        job = self.service._jobs.get(job_id)
+        if job is None:
+            raise KeyError(f"unknown job {job_id!r}")
+        return _job_doc(job)
+
+    def kill(self) -> None:
+        self.dead = True
+
+    def pump(self) -> bool:
+        """Advance an inline (start=False) service one scheduling
+        quantum; True while it has work. Threaded services drain
+        themselves — pumping them would run quanta on the router
+        thread."""
+        if self.dead or self.service._workers:
+            return False
+        try:
+            return self.service.step()
+        except Exception:  # a shard's crash is its own; the router routes
+            logger.exception("router: in-proc shard %s pump failed",
+                             self.shard_id)
+            return False
+
+    def describe(self) -> dict:
+        return {"kind": self.kind, "dead": self.dead,
+                "closed": self.closed(),
+                "admission_state": self.admission_state(),
+                "queue_depth": self.queue_depth(),
+                "journal_path": self.journal_path}
+
+
+class HttpShard:
+    """A peer shard process reached over its telemetry server's routed
+    surface (`POST /router/submit`, `GET /router/job` — ShardServer,
+    gated on MPLC_TPU_ROUTER_SERVE=1). Discovered through the fleet
+    state dir: the shard's published state carries its `port` and
+    `journal_path`, refreshed on every cluster-view poll."""
+
+    kind = "http"
+
+    def __init__(self, shard_id: str, port: "int | None" = None,
+                 host: str = "127.0.0.1",
+                 journal_path: "str | None" = None,
+                 credential: "str | None" = None):
+        self.shard_id = str(shard_id)
+        self.host = host
+        self.port = port
+        self.journal_path = journal_path
+        self.dead = False
+        self._drained = False
+        self._admission_state = "healthy"
+        self._queue_depth = 0
+        self._closed = False
+        self._last_probe = 0.0
+        # operator bearer for the polling GET (the submit credential
+        # rides each request body)
+        self._credential = credential
+
+    def update_from_state(self, row: dict) -> None:
+        """Fold one published cluster_view row into the handle."""
+        if row.get("port") is not None:
+            self.port = int(row["port"])
+        if row.get("journal_path"):
+            self.journal_path = row["journal_path"]
+        self._admission_state = row.get("admission_state") or "healthy"
+        self._queue_depth = int(row.get("queue_depth") or 0)
+        self._closed = bool(row.get("closed"))
+
+    def admission_state(self) -> str:
+        return self._admission_state
+
+    def queue_depth(self) -> int:
+        return self._queue_depth
+
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- wire ------------------------------------------------------------
+
+    def _url(self, path: str) -> str:
+        if self.port is None:
+            raise ShardUnavailable(
+                f"shard {self.shard_id!r} has not published a port")
+        return f"http://{self.host}:{self.port}{path}"
+
+    def _request(self, path: str, body: "dict | None" = None) -> dict:
+        req = urllib.request.Request(
+            self._url(path),
+            data=(json.dumps(body).encode() if body is not None
+                  else None),
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": f"Bearer {self._credential}"}
+                        if self._credential else {})},
+            method="POST" if body is not None else "GET")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=_HTTP_TIMEOUT_SEC) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            raise self._classify(e) from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise ShardUnavailable(
+                f"shard {self.shard_id!r} unreachable: {e}") from e
+
+    def _classify(self, e: "urllib.error.HTTPError") -> ServiceError:
+        try:
+            doc = json.loads(e.read().decode() or "{}")
+        except Exception:
+            doc = {}
+        msg = doc.get("error") or f"HTTP {e.code}"
+        if e.code == 429:
+            err = (JobShed if doc.get("kind") == "shed"
+                   else ServiceOverloaded)(
+                msg, retry_after_sec=float(
+                    doc.get("retry_after_sec") or 0.0))
+            err.cluster = doc.get("cluster")
+            return err
+        if e.code in (401, 403):
+            return ServiceAuthError(msg)
+        if e.code == 503:
+            return ShardUnavailable(msg)
+        return ServiceError(msg)
+
+    def submit(self, req: dict, recover: "dict | None" = None):
+        body = {"spec": req.get("spec"), "method": req["method"],
+                "tenant": req["tenant"], "job_id": req["job_id"],
+                "priority": req.get("priority"),
+                "deadline_sec": req.get("deadline_sec"),
+                "credential": req.get("credential")}
+        if recover is not None:
+            body["recover"] = {
+                "partners_count": recover.get("partners_count"),
+                "values": [[list(s), v] for s, v in
+                           sorted((recover.get("values") or {}).items())]}
+        ack = self._request("/router/submit", body)
+        return ack.get("job") or req["job_id"]
+
+    def job_status(self, job_id: str) -> dict:
+        return self._request(f"/router/job?id={_urlquote(job_id)}")
+
+    def healthz_ok(self) -> bool:
+        try:
+            self._request("/healthz")
+            return True
+        except ShardUnavailable:
+            return False
+        except ServiceError:
+            # an HTTP error status other than 503 still proves liveness
+            return True
+
+    def kill(self) -> None:
+        # the process itself is killed by whoever owns it (load_gen's
+        # driver, an operator); the router's part is the table drain
+        self.dead = True
+
+    def pump(self) -> bool:
+        return False
+
+    def describe(self) -> dict:
+        return {"kind": self.kind, "dead": self.dead,
+                "closed": self._closed, "port": self.port,
+                "admission_state": self._admission_state,
+                "queue_depth": self._queue_depth,
+                "journal_path": self.journal_path}
+
+
+def _job_doc(job) -> dict:
+    """One job's wire/status document (shared by the in-proc handle and
+    the ShardServer's GET /router/job): terminal status, scores and the
+    full v(S) table — host-side floats that round-trip exactly through
+    JSON, which is what makes the router's bit-identity check wire-safe."""
+    doc = {"job": job.job_id, "tenant": job.tenant,
+           "status": job.status, "done": job.done,
+           "error": (f"{type(job.error).__name__}: {job.error}"
+                     if job.error is not None else None)}
+    if job.done and job.error is None:
+        scores = job.scores
+        if scores is not None and hasattr(scores, "tolist"):
+            scores = scores.tolist()
+        doc["scores"] = scores
+        if job.values is not None:
+            doc["values"] = [[list(s), float(v)]
+                             for s, v in sorted(job.values.items())]
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# routed job handle
+# ---------------------------------------------------------------------------
+
+class RoutedJob:
+    """Handle for one router-submitted job. Mirrors the `SweepJob`
+    consumer surface (`done` / `status` / `result`) but survives
+    failover: when the accepting shard dies, the router resubmits and
+    swaps the inner handle — the caller's `result()` keeps working and
+    returns values bit-identical to a fault-free run."""
+
+    def __init__(self, router, job_id: str, tenant: str):
+        self.router = router
+        self.job_id = job_id
+        self.tenant = tenant
+        self.shard_id: "str | None" = None
+        self.attempts = 0
+        self.failed_over = False
+        self._inner = None          # SweepJob (in-proc shards)
+        self._remote: "HttpShard | None" = None
+        self._error: "BaseException | None" = None
+        self._final: "dict | None" = None
+
+    @property
+    def done(self) -> bool:
+        if self._error is not None or self._final is not None:
+            return True
+        if self._inner is not None:
+            return self._inner.done
+        return False
+
+    @property
+    def status(self) -> str:
+        if self._error is not None:
+            return "failed"
+        if self._final is not None:
+            return self._final.get("status", "done")
+        if self._inner is not None:
+            return self._inner.status
+        return "routed"
+
+    def result(self, timeout: "float | None" = None):
+        """Block for the contributivity scores; raises the terminal
+        error (`RoutedJobFailed` after budget exhaustion, the shard's
+        own `JobQuarantined`/`JobCancelled`/`JobShed` otherwise)."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            if self._error is not None:
+                raise self._error
+            if self._inner is not None:
+                wait = (None if deadline is None
+                        else max(deadline - time.monotonic(), 0.0))
+                try:
+                    return self._inner.result(wait)
+                except TimeoutError:
+                    raise
+                except ServiceError:
+                    # the shard may have died mid-wait and the router
+                    # swapped the handle — only surface a terminal error
+                    # that is still THIS job's word
+                    if self._error is not None:
+                        raise self._error from None
+                    raise
+            doc = self._final or self.router._poll_job(self)
+            if doc is not None and doc.get("done"):
+                self._final = doc
+                if doc.get("error"):
+                    raise ServiceError(
+                        f"routed job {self.job_id} failed on shard "
+                        f"{self.shard_id}: {doc['error']}")
+                return doc.get("scores")
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"routed job {self.job_id} not finished "
+                    f"(status={self.status})")
+            time.sleep(0.05)
+
+    def values(self) -> "dict | None":
+        """The completed job's full v(S) table `{subset_tuple: float}`
+        (None until done) — the bit-identity surface the chaos
+        acceptance compares against a solo fault-free run."""
+        if self._inner is not None and self._inner.values is not None:
+            return dict(self._inner.values)
+        doc = self._final
+        if doc is None and self._remote is not None:
+            doc = self.router._poll_job(self)
+            if doc is not None and doc.get("done"):
+                self._final = doc
+        if doc and doc.get("values") is not None:
+            return {tuple(s): float(v) for s, v in doc["values"]}
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+class FleetRouter:
+    """The routing front (module docstring). Construct with explicit
+    shards (`add_shard` / the `shards=` mapping of id -> SweepService
+    for in-proc fleets) and/or a fleet `state_dir` whose published shard
+    states are folded into the table on every refresh (HTTP peers).
+
+    Thread-safe for concurrent `submit` callers; the backoff sleeps
+    happen outside the table lock. `close()` unregisters the /varz
+    provider and closes the router's own re-pin journal — it does NOT
+    shut the shards down (the router fronts services it doesn't own)."""
+
+    def __init__(self, shards: "dict | None" = None,
+                 state_dir: "str | None" = None,
+                 budget: "int | None" = None,
+                 backoff_sec: "float | None" = None,
+                 repin_overloads: "int | None" = None,
+                 journal_path: "str | None" = None,
+                 fault_plan: "list | str | None" = None,
+                 credential: "str | None" = None):
+        self._budget = (int(budget) if budget is not None
+                        else constants._env_positive_int(
+                            constants.ROUTER_BUDGET_ENV, 8))
+        self._backoff = (float(backoff_sec) if backoff_sec is not None
+                         else constants._env_nonneg_float(
+                             constants.ROUTER_BACKOFF_ENV, 0.05))
+        self._repin_overloads = (
+            int(repin_overloads) if repin_overloads is not None
+            else constants._env_positive_int(
+                constants.ROUTER_REPIN_OVERLOADS_ENV, 3))
+        if fault_plan is None:
+            fault_plan = faults.router_fault_plan_from_env()
+        elif isinstance(fault_plan, str):
+            fault_plan = faults.parse_router_fault_plan(fault_plan)
+        self._plan = list(fault_plan)
+        self._fired: set = set()
+        self._state_dir = state_dir
+        self._credential = credential
+        self._lock = threading.RLock()
+        self._shards: dict = {}          # shard_id -> handle, insert order
+        self._pins: dict = {}            # tenant -> shard_id
+        self._pin_overloads: dict = {}   # tenant -> consecutive overloads
+        self._routed: dict = {}          # job_id -> {"req", "shard", "handle"}
+        self._next_id = 0
+        self._last_view_ts = 0.0
+        self._t0 = time.monotonic()
+        self._journal = (SweepJournal(journal_path)
+                         if journal_path else None)
+        # totals mirrored on /varz and the report's router row
+        self.stats = {"routed": 0, "resubmits": 0, "repins": 0,
+                      "failovers": 0, "budget_exhausted": 0}
+        if shards:
+            for sid, svc in shards.items():
+                self.add_shard(sid, svc)
+        self._provider_key = f"router_{id(self):x}"
+        obs_export.register_varz(self._provider_key,
+                                 weakref.WeakMethod(self.varz_view))
+
+    # -- table management ------------------------------------------------
+
+    def add_shard(self, shard_id: str, service_or_handle) -> None:
+        """Add a shard: a `SweepService` (wrapped in an `InProcShard`)
+        or a pre-built handle (`InProcShard` / `HttpShard`)."""
+        handle = service_or_handle
+        if not isinstance(handle, (InProcShard, HttpShard)):
+            handle = InProcShard(shard_id, service_or_handle)
+        with self._lock:
+            self._shards[str(shard_id)] = handle
+
+    def shard_ids(self) -> list:
+        with self._lock:
+            return list(self._shards)
+
+    def close(self) -> None:
+        obs_export.unregister(self._provider_key)
+        if self._journal is not None:
+            self._journal.close()
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- liveness + chaos ------------------------------------------------
+
+    def _resolve_shard_name(self, name: str) -> "str | None":
+        """A fault-plan shard name: exact table id first, else
+        `shard<N>` addresses the N-th shard (0-based insertion order) —
+        so one plan string works against auto-generated `pid<...>`
+        ids."""
+        with self._lock:
+            if name in self._shards:
+                return name
+            m = re.fullmatch(r"shard(\d+)", name)
+            if m is not None:
+                ids = list(self._shards)
+                n = int(m.group(1))
+                if n < len(ids):
+                    return ids[n]
+        return None
+
+    def _poll_faults(self) -> None:
+        if not self._plan:
+            return
+        elapsed = time.monotonic() - self._t0
+        for i, entry in enumerate(self._plan):
+            if i in self._fired or elapsed < entry["at_sec"]:
+                continue
+            self._fired.add(i)
+            sid = self._resolve_shard_name(entry["shard"])
+            obs_trace.event("router.fault", kind=entry["kind"],
+                            shard=sid or entry["shard"],
+                            at_sec=entry["at_sec"])
+            if sid is not None:
+                self.kill_shard(sid)
+            else:
+                logger.warning(
+                    "router: fault plan names unknown shard %r "
+                    "(table: %s)", entry["shard"], list(self._shards))
+
+    def kill_shard(self, shard_id: str) -> None:
+        """Chaos/test hook: kill a shard (in-proc: abandon the service
+        without shutdown; HTTP: drain the handle — the process itself is
+        killed by its owner) and run failover for its incomplete jobs."""
+        with self._lock:
+            shard = self._shards[str(shard_id)]
+            shard.kill()
+        self._failover(shard)
+
+    def _refresh(self) -> None:
+        """One liveness pass: fire due chaos entries, fold the published
+        cluster view into the table (HTTP discovery + admission states),
+        and failover any shard newly found dead (stale state / 503 /
+        unreachable)."""
+        self._poll_faults()
+        view = None
+        if self._state_dir:
+            from ..parallel import fleet
+            view = fleet.cluster_view(self._state_dir)
+            with self._lock:
+                for sid, row in view["shards"].items():
+                    handle = self._shards.get(sid)
+                    if handle is None:
+                        handle = HttpShard(sid,
+                                           credential=self._credential)
+                        handle.update_from_state(row)
+                        self._shards[sid] = handle
+                    elif isinstance(handle, HttpShard):
+                        handle.update_from_state(row)
+        dead = []
+        with self._lock:
+            for sid, shard in self._shards.items():
+                if shard.dead:
+                    continue
+                if self._looks_dead(shard, view):
+                    shard.kill()
+                    dead.append(shard)
+        for shard in dead:
+            self._failover(shard)
+
+    def _looks_dead(self, shard, view: "dict | None") -> bool:
+        if isinstance(shard, InProcShard):
+            return False  # killed only explicitly (kill_shard / plan)
+        row = (view or {}).get("shards", {}).get(shard.shard_id)
+        if row is not None and row.get("stale"):
+            # heartbeat went stale: probe /healthz before declaring
+            # death — a shard starved of publish cycles may still serve
+            return not self._probe(shard)
+        if row is None and view is not None:
+            return not self._probe(shard)
+        return False
+
+    def _probe(self, shard: "HttpShard") -> bool:
+        now = time.monotonic()
+        if now - shard._last_probe < _PROBE_INTERVAL_SEC:
+            return not shard.dead
+        shard._last_probe = now
+        return shard.healthz_ok()
+
+    # -- shard selection -------------------------------------------------
+
+    def _offerable(self, exclude) -> list:
+        """Shards the router will offer new work: alive, not closed,
+        admission-HEALTHY (the cluster-wide shed coordination — a
+        deferring/shedding governor gets nothing new). Falls back to
+        alive-but-unhealthy when no healthy shard remains: degraded
+        routing beats refusing the whole fleet's work."""
+        with self._lock:
+            alive = [s for sid, s in self._shards.items()
+                     if not s.dead and not s.closed()
+                     and sid not in exclude]
+        healthy = [s for s in alive
+                   if s.admission_state() == "healthy"]
+        return healthy or alive
+
+    def _pick(self, tenant: str, exclude, prefer: "str | None" = None):
+        """Sticky pin first, the redirect hint second, least-loaded
+        last. Returns None when nothing is offerable."""
+        cands = self._offerable(exclude)
+        if not cands:
+            return None
+        by_id = {s.shard_id: s for s in cands}
+        pin = self._pins.get(tenant)
+        if pin in by_id:
+            return by_id[pin]
+        if prefer in by_id:
+            return by_id[prefer]
+        return min(cands, key=lambda s: s.queue_depth())
+
+    # -- stickiness ------------------------------------------------------
+
+    def _break_pin(self, tenant: str, reason: str,
+                   to: "str | None" = None) -> None:
+        old = self._pins.pop(tenant, None)
+        if old is None:
+            return
+        self._pin_overloads.pop(tenant, None)
+        self.stats["repins"] += 1
+        obs_metrics.counter("router.repins").inc()
+        obs_trace.event("router.repin", tenant=tenant, **{"from": old},
+                        to=to, reason=reason)
+        if self._journal is not None:
+            try:
+                self._journal.append({"type": "repin", "tenant": tenant,
+                                      "from": old, "to": to,
+                                      "reason": reason})
+            except OSError as e:
+                logger.warning("router: could not journal re-pin: %s", e)
+
+    def _note_overload(self, tenant: str, shard_id: str) -> None:
+        if self._pins.get(tenant) != shard_id:
+            return
+        n = self._pin_overloads.get(tenant, 0) + 1
+        self._pin_overloads[tenant] = n
+        if n >= self._repin_overloads:
+            # sustained overload from the pinned shard: a deliberate
+            # re-pin (the new pin lands on the next accepted submit)
+            self._break_pin(tenant, reason="overload")
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, scenario=None, method: str = "Shapley values",
+               tenant: str = "tenant0",
+               deadline_sec: "float | None" = None,
+               job_id: "str | None" = None,
+               priority: "int | None" = None,
+               credential: "str | None" = None,
+               spec: "dict | None" = None) -> RoutedJob:
+        """Route one job to the fleet. `scenario` feeds in-proc shards
+        directly; `spec` is the serializable game description
+        (`scenario_builder` arguments) an HTTP peer rebuilds it from —
+        pass both when the fleet mixes flavors. Returns a `RoutedJob`;
+        raises `RoutedJobFailed` when the routing budget is exhausted
+        and `ServiceAuthError` when the shard rejects the credential
+        (auth errors are the caller's mistake — retrying them would
+        spend budget on a wrong password)."""
+        with self._lock:
+            self._next_id += 1
+            if job_id is None:
+                job_id = f"rt{self._next_id}"
+            if job_id in self._routed:
+                raise ValueError(
+                    f"job id {job_id!r} already routed by this router")
+        if credential is None:
+            credential = self._credential
+        req = {"scenario": scenario, "spec": spec, "method": method,
+               "tenant": tenant, "deadline_sec": deadline_sec,
+               "job_id": job_id, "priority": priority,
+               "credential": credential}
+        handle = RoutedJob(self, job_id, tenant)
+        t0 = time.monotonic()
+        inner, shard, attempts = self._route(req, handle)
+        route_s = time.monotonic() - t0
+        handle.attempts = attempts
+        self._accept(handle, req, shard, inner)
+        self.stats["routed"] += 1
+        obs_metrics.counter("router.jobs_routed").inc()
+        obs_metrics.histogram("router.route_sec",
+                              tenant=tenant).observe(route_s)
+        obs_trace.event("router.submit", tenant=tenant, job=job_id,
+                        shard=shard.shard_id, attempts=attempts,
+                        route_s=round(route_s, 6))
+        return handle
+
+    def _accept(self, handle: RoutedJob, req: dict, shard,
+                inner) -> None:
+        with self._lock:
+            handle.shard_id = shard.shard_id
+            if isinstance(shard, InProcShard):
+                handle._inner = inner
+                handle._remote = None
+            else:
+                handle._inner = None
+                handle._remote = shard
+            self._routed[handle.job_id] = {"req": req,
+                                           "shard": shard.shard_id,
+                                           "handle": handle}
+            self._pins[req["tenant"]] = shard.shard_id
+            self._pin_overloads[req["tenant"]] = 0
+
+    def _route(self, req: dict, handle: RoutedJob,
+               recover: "dict | None" = None,
+               exclude: "frozenset | set" = frozenset()) -> tuple:
+        """The routing core: pick -> submit -> follow redirects, bounded
+        by the budget. Returns `(inner, shard, attempts)`."""
+        exclude = set(exclude)
+        tenant, job_id = req["tenant"], req["job_id"]
+        attempts = 0
+        prefer = None
+        last: "BaseException | None" = None
+        while True:
+            self._refresh()
+            shard = self._pick(tenant, exclude, prefer)
+            prefer = None
+            if shard is None:
+                raise self._exhaust(
+                    req, attempts,
+                    "no live shard remains in the routing table", last)
+            attempts += 1
+            try:
+                inner = shard.submit(req, recover=recover)
+            except (ServiceOverloaded, JobShed) as e:
+                last = e
+                self._note_overload(tenant, shard.shard_id)
+                self.stats["resubmits"] += 1
+                obs_metrics.counter("router.resubmits").inc()
+                hint = float(getattr(e, "retry_after_sec", 0.0) or 0.0)
+                prefer = ((getattr(e, "cluster", None) or {})
+                          .get("least_loaded"))
+                if prefer == shard.shard_id:
+                    prefer = None
+                obs_trace.event("router.redirect", tenant=tenant,
+                                job=job_id, attempt=attempts,
+                                retry_after_sec=round(hint, 6),
+                                to=prefer, **{"from": shard.shard_id})
+                if attempts >= self._budget:
+                    raise self._exhaust(
+                        req, attempts,
+                        f"last shard {shard.shard_id!r} said: {e}", e)
+                self._backoff_wait(hint, attempts)
+            except (ShardUnavailable, ServiceClosed) as e:
+                # the table was wrong: the shard died between refresh
+                # and submit. Drain it (failover resubmits ITS jobs;
+                # this one was never accepted there) and move on.
+                last = e
+                with self._lock:
+                    dead_shard = self._shards.get(shard.shard_id)
+                if dead_shard is not None and not dead_shard.dead:
+                    dead_shard.kill()
+                    self._failover(dead_shard)
+                exclude.add(shard.shard_id)
+                if attempts >= self._budget:
+                    raise self._exhaust(
+                        req, attempts,
+                        f"last shard {shard.shard_id!r} said: {e}", e)
+            else:
+                return inner, shard, attempts
+
+    def _exhaust(self, req: dict, attempts: int, why: str,
+                 cause: "BaseException | None") -> RoutedJobFailed:
+        self.stats["budget_exhausted"] += 1
+        obs_metrics.counter("router.budget_exhausted").inc()
+        obs_trace.event("router.exhausted", tenant=req["tenant"],
+                        job=req["job_id"], attempts=attempts,
+                        budget=self._budget)
+        err = RoutedJobFailed(
+            f"routing budget exhausted for job {req['job_id']!r} "
+            f"(tenant {req['tenant']!r}): {attempts} attempt(s) of "
+            f"{constants.ROUTER_BUDGET_ENV}={self._budget}; {why}",
+            tenant=req["tenant"], job_id=req["job_id"],
+            attempts=attempts)
+        err.__cause__ = cause
+        return err
+
+    def _backoff_wait(self, hint: float, attempt: int) -> None:
+        """Capped exponential backoff honoring the shard's own
+        retry_after hint; in-proc inline shards are pumped while the
+        router waits, so the very backpressure being backed off from is
+        actually draining."""
+        delay = min(max(hint, self._backoff * (2.0 ** (attempt - 1))),
+                    self._backoff * _BACKOFF_CAP_MULT)
+        deadline = time.monotonic() + delay
+        while True:
+            worked = False
+            with self._lock:
+                shards = list(self._shards.values())
+            for s in shards:
+                worked = s.pump() or worked
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            if not worked:
+                time.sleep(min(remaining, 0.01))
+
+    # -- failover --------------------------------------------------------
+
+    def _failover(self, shard) -> None:
+        """Drain a dead shard: replay its journal, resubmit its
+        incomplete routed jobs to survivors through the recovered-jobs/
+        WAL-seeding path (bit-identical continuations), and break the
+        sticky pins that pointed at the corpse — each tenant re-pins
+        exactly once per death, on its first resubmitted job."""
+        with self._lock:
+            if shard._drained:
+                return
+            shard._drained = True
+            victims = [rec for rec in self._routed.values()
+                       if rec["shard"] == shard.shard_id
+                       and not rec["handle"].done]
+            pinned_tenants = [t for t, sid in self._pins.items()
+                              if sid == shard.shard_id]
+        self.stats["failovers"] += 1
+        obs_metrics.counter("router.failovers").inc()
+        recovered = self._replay_journal(shard)
+        resubmitted = 0
+        for rec in victims:
+            req, handle = rec["req"], rec["handle"]
+            jrec = recovered.get(req["job_id"]) or {}
+            if jrec.get("done") and handle._inner is not None \
+                    and handle._inner.done:
+                continue  # completed before death; the handle has it
+            recover = {"values": jrec.get("values") or {},
+                       "partners_count": (
+                           jrec.get("partners_count")
+                           if jrec.get("partners_count") is not None
+                           else self._partners_of(req))}
+            # the re-pin: break the dead pin BEFORE routing so the pick
+            # lands on a survivor; the accept below establishes the new
+            # pin — exactly one repin per (tenant, death)
+            if self._pins.get(req["tenant"]) == shard.shard_id:
+                self._break_pin(req["tenant"], reason="death")
+            try:
+                inner, new_shard, attempts = self._route(
+                    req, handle, recover=recover,
+                    exclude={shard.shard_id})
+            except RoutedJobFailed as e:
+                # surfaced classified on the handle — a failover that
+                # cannot place a job must not hang its consumer
+                handle._error = e
+                if handle._inner is not None:
+                    handle._inner = None
+                continue
+            handle.attempts += attempts
+            handle.failed_over = True
+            self._accept(handle, req, new_shard, inner)
+            resubmitted += 1
+        # tenants pinned to the corpse with no in-flight job still need
+        # their pin broken (their NEXT submit re-pins)
+        with self._lock:
+            remaining = [t for t in pinned_tenants
+                         if self._pins.get(t) == shard.shard_id]
+        for t in remaining:
+            self._break_pin(t, reason="death")
+        obs_trace.event("router.failover", shard=shard.shard_id,
+                        jobs=len(victims), resubmitted=resubmitted)
+
+    @staticmethod
+    def _partners_of(req: dict) -> "int | None":
+        sc = req.get("scenario")
+        if sc is not None:
+            return int(sc.partners_count)
+        spec = req.get("spec") or {}
+        return (int(spec["partners"]) if spec.get("partners") is not None
+                else None)
+
+    def _replay_journal(self, shard) -> dict:
+        """A dead shard's WAL -> `{job_id: {"values": {subset: float},
+        "done": bool, "partners_count": int}}` — the same records
+        `SweepService._replay_record` reads, replayed router-side
+        because the dead service can no longer do it for us. A missing
+        or torn journal yields what it yields: failover reseeds from
+        whatever was durably harvested, the rest retrains (identically —
+        that is the WAL-seeding contract)."""
+        path = shard.journal_path
+        out: dict = {}
+        if not path or not os.path.exists(path):
+            return out
+        try:
+            records, _torn = SweepJournal.replay(path)
+        except Exception as e:  # corrupt mid-file: recover nothing
+            logger.warning("router: journal replay for dead shard %s "
+                           "failed: %s", shard.shard_id, e)
+            return out
+        for rec in records:
+            kind, job = rec.get("type"), rec.get("job")
+            if kind == "submit":
+                slot = out.setdefault(job, {"values": {}, "done": False})
+                slot["partners_count"] = rec.get("partners_count")
+            elif kind == "value" and job in out:
+                out[job]["values"][tuple(rec["subset"])] = rec["value"]
+            elif kind in ("done", "quarantine", "cancel", "shed") \
+                    and job in out:
+                out[job]["done"] = True
+        return out
+
+    # -- polling / pumping ----------------------------------------------
+
+    def _poll_job(self, handle: RoutedJob) -> "dict | None":
+        self._refresh()
+        with self._lock:
+            shard = self._shards.get(handle.shard_id)
+        if shard is None or shard.dead:
+            return None
+        try:
+            return shard.job_status(handle.job_id)
+        except (ShardUnavailable, KeyError):
+            return None
+
+    def pump(self) -> bool:
+        """Advance every alive inline in-proc shard one quantum (and
+        fire due chaos entries). True while any shard reports work or
+        any routed job is non-terminal — the deterministic drive loop
+        for tests and BENCH_CONFIG=11."""
+        self._refresh()
+        with self._lock:
+            shards = list(self._shards.values())
+            handles = [r["handle"] for r in self._routed.values()]
+        busy = False
+        for s in shards:
+            busy = s.pump() or busy
+        return busy or any(not h.done for h in handles)
+
+    def run_until_idle(self, timeout: "float | None" = None) -> None:
+        """Pump until every routed job is terminal."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while self.pump():
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("router did not drain in time")
+
+    # -- observability ---------------------------------------------------
+
+    def varz_view(self) -> dict:
+        """The /varz `router_*` block: the live routing table, sticky
+        pins and routing totals — what an operator reads to see WHERE
+        the fleet's work is going and which shards are drained."""
+        with self._lock:
+            table = {sid: s.describe()
+                     for sid, s in self._shards.items()}
+            pins = dict(self._pins)
+            jobs = {jid: {"shard": r["shard"],
+                          "status": r["handle"].status,
+                          "attempts": r["handle"].attempts,
+                          "failed_over": r["handle"].failed_over}
+                    for jid, r in self._routed.items()}
+        return {"budget": self._budget,
+                "backoff_sec": self._backoff,
+                "repin_overloads": self._repin_overloads,
+                "table": table, "pins": pins, "jobs": jobs,
+                **self.stats}
+
+
+# ---------------------------------------------------------------------------
+# shard-side HTTP peer
+# ---------------------------------------------------------------------------
+
+class ShardServer:
+    """The shard-side half of the HTTP wire: wraps this process's
+    `SweepService` as a routed peer. Registers the obs/export.py sink
+    behind `POST /router/submit` / `GET /router/job` (routes exist only
+    with `MPLC_TPU_ROUTER_SERVE=1` and a running telemetry server —
+    `MPLC_TPU_METRICS_PORT`), rebuilds each wire spec into a real
+    `Scenario` via the injected `scenario_builder(spec)`, and enforces
+    the wire's auth rule: when `MPLC_TPU_METRICS_TOKEN` is set a routed
+    submission MUST carry a credential (the in-process embedder is
+    trusted; the network authenticates)."""
+
+    def __init__(self, service, scenario_builder):
+        self.service = service
+        self.scenario_builder = scenario_builder
+        self._key = f"router_shard_{id(self):x}"
+        obs_export.register_router(self._key,
+                                   weakref.WeakMethod(self.handle))
+
+    def close(self) -> None:
+        obs_export.unregister(self._key)
+
+    def handle(self, op: str, payload: dict) -> dict:
+        if op == "submit":
+            return self._handle_submit(payload)
+        if op == "job":
+            return self._handle_job(payload)
+        raise ValueError(f"unknown router op {op!r}")
+
+    def _handle_submit(self, doc: dict) -> dict:
+        tenant = doc.get("tenant") or "tenant0"
+        credential = doc.get("credential")
+        if os.environ.get(constants.METRICS_TOKEN_ENV) and not credential:
+            raise ServiceAuthError(
+                "the routed submit surface requires a credential when "
+                f"{constants.METRICS_TOKEN_ENV} is set (the master "
+                "token, or tenant_token(master, tenant))")
+        job_id = doc.get("job_id")
+        recover = doc.get("recover")
+        if recover is not None:
+            if not job_id:
+                raise ValueError("a recover payload requires the "
+                                 "original job_id")
+            values = {tuple(int(i) for i in s): float(v)
+                      for s, v in (recover.get("values") or [])}
+            try:
+                self.service.adopt_recovered(
+                    job_id, tenant=tenant, method=doc.get("method"),
+                    partners_count=recover.get("partners_count"),
+                    values=values)
+            except ValueError:
+                # idempotent re-adoption on a routing retry (the seed
+                # values are identical by construction)
+                pass
+        scenario = self.scenario_builder(doc.get("spec") or {})
+        job = self.service.submit(
+            scenario, method=doc.get("method") or "Shapley values",
+            tenant=tenant, deadline_sec=doc.get("deadline_sec"),
+            job_id=job_id, priority=doc.get("priority"),
+            credential=credential)
+        return {"job": job.job_id, "tenant": job.tenant}
+
+    def _handle_job(self, payload: dict) -> dict:
+        job_id = payload["job"]
+        job = self.service._jobs.get(job_id)
+        if job is None:
+            raise KeyError(f"unknown job {job_id!r}")
+        return _job_doc(job)

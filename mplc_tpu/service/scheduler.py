@@ -131,6 +131,7 @@ accounting machinery holds at thousands of jobs.
 from __future__ import annotations
 
 import contextlib
+import hmac
 import itertools
 import logging
 import os
@@ -201,6 +202,15 @@ class ServiceOverloaded(ServiceError):
 
 class ServiceRejected(ServiceError):
     """Admission control refused the job (fault-plan injected reject)."""
+
+
+class ServiceAuthError(ServiceError):
+    """The submit-path credential check failed: `MPLC_TPU_METRICS_TOKEN`
+    is set (the service authenticates tenants) and the presented
+    credential is neither the master/operator token nor the tenant's own
+    HMAC credential (`obs.export.tenant_token(master, tenant)`). A
+    SYNCHRONOUS submission error — nothing was accepted, journaled or
+    quarantined; the caller's identity claim was simply wrong."""
 
 
 class JobShed(ServiceError):
@@ -796,7 +806,74 @@ class SweepService:
                  "shed": r.get("shed", False)}
                 for jid, r in self._recovered.items()]
 
+    def adopt_recovered(self, job_id: str, tenant: "str | None" = None,
+                        method: "str | None" = None,
+                        partners_count: "int | None" = None,
+                        values: "dict | None" = None) -> None:
+        """Install another shard's journaled partial job into THIS
+        service's recovered-jobs table — the fleet router's failover
+        path. The router replays a dead shard's WAL
+        (`SweepJournal.replay`), hands each incomplete job's harvested
+        `{subset_tuple: value}` map here, then resubmits under the old
+        `job_id`: `_build_engine` seeds the fresh engine's memo from
+        these values exactly as it would from this shard's own journal,
+        so the continuation is bit-identical to a solo fault-free run
+        and only never-harvested coalitions train. Refuses a `job_id`
+        already known to this service (live or recovered — adopting
+        over either would mix two games' v(S) tables)."""
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed("service is shut down")
+            if job_id in self._jobs:
+                raise ValueError(
+                    f"job id {job_id!r} is already live on this service "
+                    "— cannot adopt a foreign journal's values for it")
+            if job_id in self._recovered:
+                raise ValueError(
+                    f"job id {job_id!r} already has recovered state on "
+                    "this service — refusing to overwrite it with a "
+                    "foreign journal's")
+            self._recovered[job_id] = {
+                "values": {tuple(s): float(v)
+                           for s, v in (values or {}).items()},
+                "done": False, "quarantined": False, "cancelled": False,
+                "shed": False, "tenant": tenant, "method": method,
+                "partners_count": (int(partners_count)
+                                   if partners_count is not None
+                                   else None)}
+
     # -- submission ------------------------------------------------------
+
+    def _check_credential(self, tenant: str,
+                          credential: "str | None") -> None:
+        """Authenticate a submit-path tenant claim (PR-12/PR-18 scheme):
+        with `MPLC_TPU_METRICS_TOKEN` set, a presented credential must be
+        the master/operator token or `tenant_token(master, tenant)` —
+        anything else raises `ServiceAuthError` synchronously (an auth
+        error is a caller mistake, never a quarantine). `credential=None`
+        stays the trusted in-process embedder path (the process that can
+        call this method could also read the master token from its own
+        environment); the HTTP routed-submit surface REQUIRES the
+        credential, so the trust boundary authenticates. With no master
+        token configured there is no credential scheme to check against
+        and every claim passes, unchanged."""
+        if credential is None:
+            return
+        master = os.environ.get(constants.METRICS_TOKEN_ENV)
+        if not master:
+            return
+        cred = str(credential).encode("utf-8", "surrogatepass")
+        ok = hmac.compare_digest(cred, master.encode())
+        if not ok:
+            expect = obs_export.tenant_token(master, tenant)
+            ok = hmac.compare_digest(cred, expect.encode())
+        if not ok:
+            obs_metrics.counter("service.auth_rejected").inc()
+            obs_trace.event("service.auth_reject", tenant=tenant)
+            raise ServiceAuthError(
+                f"credential does not authenticate tenant {tenant!r} "
+                "(expected the master token or tenant_token(master, "
+                "tenant))")
 
     def submit(self, scenario, method: str = "Shapley values",
                tenant: str = "tenant0",
@@ -804,6 +881,7 @@ class SweepService:
                job_id: "str | None" = None,
                priority: "int | None" = None,
                profile: bool = False,
+               credential: "str | None" = None,
                _live: "dict | None" = None) -> SweepJob:
         """Accept a Scenario+method job onto the bounded queue.
 
@@ -818,11 +896,20 @@ class SweepService:
         degrades to a warning, never a job fault). The trace path is
         recorded on the job's terminal `service.job` event.
 
+        `credential` authenticates the `tenant` claim when
+        `MPLC_TPU_METRICS_TOKEN` is set (master token or
+        `tenant_token(master, tenant)`; a mismatch raises
+        `ServiceAuthError` synchronously). None = the trusted in-process
+        caller, unchanged — the HTTP routed-submit surface is where the
+        credential is mandatory.
+
         Raises `ServiceClosed` after shutdown, `ServiceOverloaded` when
         the queue is at `MPLC_TPU_SERVICE_MAX_PENDING` (backpressure —
         its `retry_after_sec` is the live queue-wait p50 backoff hint),
-        `ServiceRejected` on a fault-plan injected admission reject. The
-        accepted submission is journaled before this returns."""
+        `ServiceRejected` on a fault-plan injected admission reject,
+        `ServiceAuthError` on a bad credential. The accepted submission
+        is journaled before this returns."""
+        self._check_credential(tenant, credential)
         if _live is not None:
             from ..live import LIVE_METHODS
             if _live["method"] not in LIVE_METHODS:
@@ -1010,8 +1097,14 @@ class SweepService:
         feed the tenant's resident game, so round arrival needs no
         in-process call. Error contract (mapped to HTTP by the handler):
         KeyError = unknown tenant (404), ValueError = malformed round
-        (400); `LiveGameFull`/`LiveResidencyFull` propagate with their
-        `retry_after_sec` backoff hint (429 + Retry-After)."""
+        (400), `ServiceAuthError` = a wire credential that does not
+        authenticate the tenant (403); `LiveGameFull`/
+        `LiveResidencyFull` propagate with their `retry_after_sec`
+        backoff hint (429 + Retry-After)."""
+        # a credential riding the wire document authenticates the tenant
+        # claim exactly as on submit (the HTTP handler ALSO checks its
+        # path-bound bearer token — this covers in-process dispatchers)
+        self._check_credential(tenant, doc.get("credential"))
         game = self._live_games.get(tenant)
         if game is None:
             raise KeyError(f"no live game for tenant {tenant!r}")
@@ -1036,6 +1129,7 @@ class SweepService:
                     priority: "int | None" = None,
                     prune: "float | None" = None,
                     accuracy_target: "float | None" = None,
+                    credential: "str | None" = None,
                     **method_kw) -> SweepJob:
         """Submit a low-latency live contributivity query against the
         tenant's resident game. Rides the EXISTING admission/priority/
@@ -1062,7 +1156,12 @@ class SweepService:
         from the deadline before routing (floored at a tenth of the
         SLO), so the chosen estimator fits what REMAINS of the tier's
         SLO after queueing, not the wall-clock deadline the job itself
-        is still held to."""
+        is still held to.
+
+        `credential` authenticates the tenant claim exactly as in
+        `submit` — checked BEFORE the planner runs (an unauthenticated
+        caller must not even spend the planning work)."""
+        self._check_credential(tenant, credential)
         game = self._live_games.get(tenant)
         if game is None:
             raise ServiceError(
@@ -1273,6 +1372,12 @@ class SweepService:
                     self._terminal(job, "cancelled",
                                    JobCancelled("service shutdown"))
             self._lock.notify_all()
+        # force-publish the `closed: true` state BEFORE draining: without
+        # this a cleanly shut-down shard keeps its last (healthy,
+        # queue_depth 0) state file for up to the staleness bound and the
+        # cluster view recommends a corpse as "least loaded" — exactly
+        # the redirect a router must never follow
+        self._publish_fleet_state(force=True)
         if drain:
             self.drain(timeout)
         for w in self._workers:
@@ -1329,6 +1434,15 @@ class SweepService:
                 "workers": max(len(self._workers), 1),
                 "admission_state": self._admission.state,
                 "closed": self._closed,
+                # where a fleet router reaches this shard's HTTP surface
+                # (None when no telemetry server is up): the published
+                # state dir doubles as the router's service discovery
+                "port": obs_export.active_port(),
+                # where this shard's WAL lives (None when unjournaled):
+                # a router performing failover replays the dead shard's
+                # journal from here to resubmit its incomplete jobs
+                "journal_path": (self._journal.path
+                                 if self._journal is not None else None),
             }
         # the full metrics snapshot rides along (shared log2 buckets):
         # this is what makes the published state dir a SERVERLESS fleet

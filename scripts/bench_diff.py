@@ -45,6 +45,9 @@ sidecar pairs have different engine fingerprints BY DESIGN (precision
 is fingerprinted), so this block is their value truth and satisfies
 `--gate` where the numerics gate cannot run. The live bench's
 `recon.kernel_query_s` row tracks the fused-kernel fresh-query latency.
+The fleet-router bench's `router.*` rows (config 11 sidecar) track
+end-to-end routing latency quantiles and the redirect/exhaustion totals
+of its planned-kill chaos run.
 """
 
 from __future__ import annotations
@@ -93,6 +96,15 @@ _ROWS = {
     # p50 WAL-restore second (the manager's retry_after_sec basis)
     "live.p99_fresh_query_s": "lower",
     "live.restore_s": "lower",
+    # fleet-router rows (config 11 sidecar, `router` block at top
+    # level): end-to-end routing latency through the pick/redirect/
+    # backoff core, and the totals the chaos plan makes deterministic —
+    # resubmits and budget exhaustions growing means the router started
+    # paying (or losing) more redirects for the same planned kill
+    "router.route_s.p50": "lower",
+    "router.route_s.p99": "lower",
+    "router.resubmits": "lower",
+    "router.budget_exhausted": "lower",
 }
 
 #: a non-fp32 run's Kendall tau-b against its own fp32 reference twin

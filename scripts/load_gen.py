@@ -43,6 +43,22 @@ The service under test is in-process (the engine is a library, not an
 RPC server yet); /metrics is scraped over real HTTP when
 `MPLC_TPU_METRICS_PORT` is set, so the telemetry plane is exercised
 end-to-end too.
+
+Fleet-router chaos mode (`--router`): the driver spawns N REAL shard
+subprocesses (`--router-shard` server mode: SweepService + ShardServer
+behind the telemetry server's `/router/*` surface, heartbeating into a
+shared fleet state dir), fronts them with a `FleetRouter` discovered
+purely from that state dir, routes a stream of jobs through it, then
+SIGKILLs one shard mid-run per the `shardkill@<shard>:sec<F>` plan.
+The router must detect the corpse (stale heartbeat -> failed /healthz
+probe), drain it from the table, replay its journal and resubmit its
+incomplete jobs to survivors — and the invariant extends the solo one:
+every routed job terminal, every completed v(S) table bit-identical to
+a solo fault-free run INCLUDING the failed-over ones, and (when a kill
+was planned) at least one failover actually happened. Exit 1 on drift:
+
+    JAX_PLATFORMS=cpu python scripts/load_gen.py --router --jobs 8 \
+        --router-shards 2 --fault-plan 'shardkill@shard0:sec3'
 """
 
 from __future__ import annotations
@@ -378,6 +394,247 @@ def run_load(jobs: int = 1000,
     }
 
 
+def scenario_from_spec(spec: dict):
+    """Rebuild a Scenario from a wire spec (`FleetRouter.submit(spec=)`)
+    — the `scenario_builder` a `--router-shard` server injects into its
+    `ShardServer`. Both the shard and the driver's solo oracle build
+    from the SAME spec, so bit-identity is a statement about the game,
+    not about pickling."""
+    return default_scenario_builder(
+        partners=int(spec.get("partners", 3)),
+        seed=int(spec.get("seed", 0)),
+        epochs=int(spec.get("epochs", 1)),
+        dataset=spec.get("dataset", "titanic"))()
+
+
+def run_router_shard(shard_id: str, workers: "int | None" = None,
+                     slice_coalitions: "int | None" = None) -> int:
+    """One shard server process: a threaded `SweepService` journaling
+    into the fleet state dir, wrapped in a `ShardServer` and exposed on
+    an ephemeral telemetry port. Runs until SIGTERM (clean drain) or
+    SIGKILL (the chaos case — the WAL is the only thing left behind,
+    which is exactly what failover replays)."""
+    import signal
+
+    from mplc_tpu import constants
+    from mplc_tpu.obs import export as obs_export
+    from mplc_tpu.service import SweepService
+    from mplc_tpu.service.router import ShardServer
+
+    state_dir = os.environ.get(constants.FLEET_STATE_DIR_ENV)
+    if not state_dir:
+        print(f"[router-shard] {constants.FLEET_STATE_DIR_ENV} must be "
+              "set", file=sys.stderr)
+        return 2
+    os.environ.setdefault(constants.FLEET_SHARD_ID_ENV, shard_id)
+    os.environ.setdefault(obs_export.ROUTER_SERVE_ENV, "1")
+    os.environ.setdefault(obs_export.METRICS_PORT_ENV, "0")
+    obs_export.maybe_start_from_env()
+
+    svc = SweepService(start=True, workers=workers or 1,
+                       slice_coalitions=slice_coalitions,
+                       journal_path=os.path.join(state_dir,
+                                                 f"{shard_id}.wal"))
+    server = ShardServer(svc, scenario_from_spec)
+    stop = {"flag": False}
+
+    def _term(signum, frame):
+        stop["flag"] = True
+    signal.signal(signal.SIGTERM, _term)
+    print(f"[router-shard] {shard_id} up on port "
+          f"{obs_export.active_port()}", file=sys.stderr)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.1)
+    finally:
+        server.close()
+        svc.shutdown(drain=False)
+    return 0
+
+
+def run_router(jobs: int = 8,
+               shards: int = 2,
+               partner_shapes=(2, 3),
+               game_seeds=(0, 1),
+               epochs: int = 1,
+               dataset: str = "titanic",
+               fault_plan: "str | None" = None,
+               slice_coalitions: "int | None" = 2,
+               stale_sec: float = 2.0,
+               timeout_sec: float = 600.0,
+               out_dir: "str | None" = None) -> dict:
+    """The multi-process router chaos run (module docstring): spawn the
+    shard fleet, route `jobs` jobs through a state-dir-discovered
+    `FleetRouter`, SIGKILL shards per `fault_plan`, and equality-check
+    the router invariant. Returns the report dict."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    from mplc_tpu import constants, faults
+    from mplc_tpu.contrib.shapley import powerset_order
+    from mplc_tpu.parallel import fleet
+    from mplc_tpu.service import FleetRouter, RoutedJobFailed
+
+    own_dir = out_dir is None
+    state_dir = out_dir or tempfile.mkdtemp(prefix="mplc_router_")
+    plan = faults.parse_router_fault_plan(fault_plan or "")
+    # the corpse-detection clock: a killed shard's heartbeat must go
+    # stale (then fail its /healthz probe) within seconds, not the
+    # 30s production default
+    os.environ[constants.FLEET_STALE_SEC_ENV] = str(stale_sec)
+    os.environ[constants.FLEET_STATE_DIR_ENV] = state_dir
+    credential = os.environ.get(constants.METRICS_TOKEN_ENV) or None
+
+    shard_ids = [f"s{i}" for i in range(shards)]
+    procs: dict = {}
+    for sid in shard_ids:
+        env = dict(os.environ)
+        env[constants.FLEET_STATE_DIR_ENV] = state_dir
+        env[constants.FLEET_SHARD_ID_ENV] = sid
+        env["MPLC_TPU_ROUTER_SERVE"] = "1"
+        env["MPLC_TPU_METRICS_PORT"] = "0"
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--router-shard", "--shard-id", sid]
+        if slice_coalitions:
+            cmd += ["--slice", str(slice_coalitions)]
+        procs[sid] = subprocess.Popen(cmd, env=env)
+
+    def _fire_due(t0: float) -> None:
+        # the driver owns the processes, so the driver wields the axe:
+        # SIGKILL (no drain, no journal close) — the router is NOT told
+        # and must detect the corpse through the state dir + probe
+        for entry in plan:
+            if entry.get("_fired") or time.monotonic() - t0 < \
+                    entry["at_sec"]:
+                continue
+            entry["_fired"] = True
+            name = entry["shard"]
+            sid = name if name in procs else (
+                shard_ids[int(name[5:])]
+                if name.startswith("shard") and name[5:].isdigit()
+                and int(name[5:]) < len(shard_ids) else None)
+            if sid is None or procs[sid].poll() is not None:
+                continue
+            print(f"[router] SIGKILL shard {sid} at "
+                  f"t+{entry['at_sec']}s", file=sys.stderr)
+            procs[sid].send_signal(signal.SIGKILL)
+
+    report: dict = {"params": {
+        "jobs": jobs, "shards": shards, "fault_plan": fault_plan,
+        "slice_coalitions": slice_coalitions, "stale_sec": stale_sec,
+        "partner_shapes": list(partner_shapes),
+        "game_seeds": list(game_seeds)}}
+    router = None
+    try:
+        # readiness: every shard must publish a port before routing
+        deadline = time.monotonic() + 120.0
+        while True:
+            view = fleet.cluster_view(state_dir)
+            up = [sid for sid, row in view["shards"].items()
+                  if row.get("port")]
+            if len(up) >= shards:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {up} of {shard_ids} shards published a port")
+            if any(p.poll() is not None for p in procs.values()):
+                raise RuntimeError("a shard process died during startup")
+            time.sleep(0.1)
+
+        router = FleetRouter(state_dir=state_dir, credential=credential)
+        games = [(p, s) for p in partner_shapes for s in game_seeds]
+        handles = []
+        failed_routes = []
+        t0 = time.monotonic()
+        run_deadline = t0 + timeout_sec
+        for i in range(jobs):
+            _fire_due(t0)
+            p, s = games[i % len(games)]
+            spec = {"partners": p, "seed": s, "epochs": epochs,
+                    "dataset": dataset}
+            try:
+                h = router.submit(spec=spec, tenant=f"tier{i % 3}")
+                handles.append((h, p, s))
+            except RoutedJobFailed as e:
+                failed_routes.append(str(e))
+        while True:
+            _fire_due(t0)
+            pending = [h for h, _, _ in handles if not h.done]
+            if not pending:
+                break
+            if time.monotonic() > run_deadline:
+                break
+            router.pump()
+            for h in pending:
+                h.values()      # polls remote status, latches _final
+            time.sleep(0.05)
+
+        # -- the invariant ------------------------------------------------
+        refs: dict = {}
+        outcomes: dict = {}
+        mismatched, stuck, unclassified = [], [], []
+        for h, p, s in handles:
+            outcomes[h.status] = outcomes.get(h.status, 0) + 1
+            if not h.done:
+                stuck.append(h.job_id)
+                continue
+            if h.status == "failed" and not isinstance(
+                    h._error, RoutedJobFailed):
+                unclassified.append(h.job_id)
+            if h.status == "completed":
+                if (p, s) not in refs:
+                    refs[(p, s)] = solo_reference(
+                        lambda p=p, s=s: scenario_from_spec(
+                            {"partners": p, "seed": s, "epochs": epochs,
+                             "dataset": dataset}))
+                vals = h.values() or {}
+                want = refs[(p, s)]
+                subsets = powerset_order(p)
+                if [vals.get(sub) for sub in subsets] != \
+                        [want[sub] for sub in subsets]:
+                    mismatched.append(h.job_id)
+        planned_kills = len(plan)
+        invariant = {
+            "accepted": len(handles),
+            "failed_routes": failed_routes[:10],
+            "stuck": len(stuck), "stuck_jobs": stuck[:20],
+            "completed_games_checked": len(refs),
+            "values_bit_identical_to_solo": not mismatched,
+            "mismatched_jobs": mismatched[:20],
+            "failures_classified": not unclassified,
+            "planned_kills": planned_kills,
+            "failovers": router.stats["failovers"],
+            "failover_exercised": (router.stats["failovers"] >= 1
+                                   if planned_kills else True),
+            "holds": (not stuck and not mismatched and not unclassified
+                      and (router.stats["failovers"] >= 1
+                           if planned_kills else True)),
+        }
+        report.update({
+            "wallclock_s": time.monotonic() - t0,
+            "outcomes": outcomes,
+            "router": dict(router.stats),
+            "routing_table": router.varz_view()["table"],
+            "invariant": invariant,
+        })
+    finally:
+        if router is not None:
+            router.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=30)
+            except Exception:
+                p.kill()
+        if own_dir:
+            shutil.rmtree(state_dir, ignore_errors=True)
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--jobs", type=int, default=1000)
@@ -396,7 +653,50 @@ def main(argv=None) -> int:
                          "MPLC_TPU_FLEET_STATE_DIR to the report")
     ap.add_argument("--out", default=None,
                     help="write the JSON report here (default stdout)")
+    ap.add_argument("--router", action="store_true",
+                    help="multi-process fleet-router chaos mode: spawn "
+                         "--router-shards shard subprocesses, route "
+                         "--jobs jobs through a FleetRouter, SIGKILL "
+                         "shards per --fault-plan, verify the router "
+                         "invariant (exit 1 on drift)")
+    ap.add_argument("--router-shards", type=int, default=2)
+    ap.add_argument("--fault-plan", default=None,
+                    help="router chaos plan, e.g. 'shardkill@shard0:sec3'"
+                         " (default: MPLC_TPU_ROUTER_FAULT_PLAN)")
+    ap.add_argument("--stale-sec", type=float, default=2.0,
+                    help="fleet heartbeat staleness window for corpse "
+                         "detection in --router mode")
+    ap.add_argument("--router-shard", action="store_true",
+                    help=argparse.SUPPRESS)   # internal server mode
+    ap.add_argument("--shard-id", default="s0", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+
+    if args.router_shard:
+        return run_router_shard(args.shard_id, workers=args.workers,
+                                slice_coalitions=args.slice)
+    if args.router:
+        from mplc_tpu import faults
+        fault_plan = (args.fault_plan
+                      if args.fault_plan is not None
+                      else os.environ.get(faults.ROUTER_FAULT_PLAN_ENV))
+        report = run_router(jobs=args.jobs, shards=args.router_shards,
+                            epochs=args.epochs, fault_plan=fault_plan,
+                            slice_coalitions=args.slice or 2,
+                            stale_sec=args.stale_sec,
+                            timeout_sec=min(args.timeout_sec, 600.0))
+        text = json.dumps(report, indent=2, default=str)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
+            print(f"[load_gen] report: {args.out}", file=sys.stderr)
+        else:
+            print(text)
+        inv = report["invariant"]
+        print(f"[load_gen] router invariant holds: {inv['holds']} "
+              f"(accepted={inv['accepted']} stuck={inv['stuck']} "
+              f"bit_identical={inv['values_bit_identical_to_solo']} "
+              f"failovers={inv['failovers']})", file=sys.stderr)
+        return 0 if inv["holds"] else 1
 
     chaos_plan = (f"chaos@rate{args.chaos}:seed{args.chaos_seed}"
                   if args.chaos > 0 else None)

@@ -184,7 +184,9 @@ def test_controller_window_ages_out_a_spike():
     c._waits.append((time.monotonic() - 1e6, 50.0))  # ancient spike wait
     assert c.evaluate([]) == "healthy"
     assert len(c._waits) == 0  # pruned
-    assert c.retry_after_sec() == 0.0
+    # no history: the hint is the retry floor (never 0.0 — a zero hint
+    # licenses a hot resubmit loop against an idle-LOOKING service)
+    assert c.retry_after_sec() == pytest.approx(c.retry_floor_sec)
 
 
 def test_controller_sees_stuck_queue_through_live_ages():
@@ -197,10 +199,27 @@ def test_controller_sees_stuck_queue_through_live_ages():
 
 def test_controller_retry_after_is_windowed_p50():
     c = AdmissionController(1.0)
-    assert c.retry_after_sec() == 0.0  # no history
+    # no history: the floor, not 0.0 (MPLC_TPU_SERVICE_RETRY_FLOOR_SEC)
+    assert c.retry_after_sec() == pytest.approx(0.05)
     for w in (0.2, 0.4, 0.6):
         c.observe_queue_wait(w)
     assert c.retry_after_sec() == pytest.approx(0.4)
+
+
+def test_controller_retry_floor_env_and_p50_dominance(monkeypatch):
+    """The floor satellite: a sub-floor p50 is clamped UP to the floor,
+    a real p50 above it passes through, and the env knob retunes it."""
+    c = AdmissionController(1.0)
+    for w in (0.001, 0.002, 0.003):
+        c.observe_queue_wait(w)
+    assert c.retry_after_sec() == pytest.approx(0.05)   # floored
+    monkeypatch.setenv("MPLC_TPU_SERVICE_RETRY_FLOOR_SEC", "0.25")
+    c2 = AdmissionController(1.0)
+    assert c2.retry_floor_sec == pytest.approx(0.25)
+    assert c2.retry_after_sec() == pytest.approx(0.25)
+    for w in (0.6, 0.7, 0.8):
+        c2.observe_queue_wait(w)
+    assert c2.retry_after_sec() == pytest.approx(0.7)   # p50 wins
 
 
 def test_controller_shed_quota_targets_half_the_bound():
@@ -226,8 +245,9 @@ def test_overloaded_carries_retry_after_hint():
     svc.submit(scenario(9), tenant="A")
     with pytest.raises(ServiceOverloaded) as ei:
         svc.submit(scenario(11), tenant="B")
-    # no job ever scheduled: the hint is exactly 0.0, never None/garbage
-    assert ei.value.retry_after_sec == 0.0
+    # no job ever scheduled: the hint is the retry FLOOR, never 0.0/None
+    # (a zero hint turns every polite client into a hot resubmit loop)
+    assert ei.value.retry_after_sec == pytest.approx(0.05)
     svc.run_until_idle()
     # with queue-wait history the hint is the live p50 (> 0) and is
     # stamped into the message too

@@ -1,0 +1,493 @@
+"""The fleet router (mplc_tpu/service/router.py) and its satellites.
+
+Governing invariants, asserted throughout:
+
+  - FAILOVER BIT-IDENTITY: a job whose accepting shard is killed
+    mid-run is resubmitted to a survivor seeded from the dead shard's
+    journal, and its completed v(S) table is BIT-IDENTICAL to a solo
+    fault-free run — the caller's handle keeps working across the swap.
+  - STICKINESS: a tenant's jobs land on its pinned shard; the pin
+    breaks only on shard death or sustained overload, exactly once per
+    event, and every break is journaled with its reason.
+  - CLASSIFIED EXHAUSTION: when the routing budget runs out the caller
+    gets a `RoutedJobFailed` chaining the last shard error — never a
+    silent drop, never an unbounded redirect loop.
+  - SHED COORDINATION: a deferring/shedding shard is offered nothing
+    new while a healthy sibling exists.
+
+Plus the ISSUE 19 satellites: the authenticated submit path
+(`tenant_token`), the `retry_after_sec` floor (test_admission.py),
+stale-shard exclusion from `cluster_view` least-loaded hints, and the
+BENCH_CONFIG=11 wiring.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mplc_tpu import faults
+from mplc_tpu.contrib.engine import CharacteristicEngine
+from mplc_tpu.contrib.shapley import powerset_order
+from mplc_tpu.obs import export as obs_export
+from mplc_tpu.obs import metrics, trace
+from mplc_tpu.parallel import fleet
+from mplc_tpu.service import (FleetRouter, RoutedJobFailed,
+                              ServiceAuthError, SweepJournal, SweepService)
+from mplc_tpu.service.router import InProcShard, ShardServer
+
+P = 3
+SUBSETS = powerset_order(P)
+
+_KNOBS = ("MPLC_TPU_SERVICE_FAULT_PLAN", "MPLC_TPU_SERVICE_MAX_PENDING",
+          "MPLC_TPU_SERVICE_SLICE", "MPLC_TPU_SERVICE_RETRY_FLOOR_SEC",
+          "MPLC_TPU_SERVICE_SHED_P99_SEC", "MPLC_TPU_ROUTER_BUDGET",
+          "MPLC_TPU_ROUTER_BACKOFF_SEC", "MPLC_TPU_ROUTER_REPIN_OVERLOADS",
+          "MPLC_TPU_ROUTER_FAULT_PLAN", "MPLC_TPU_ROUTER_SERVE",
+          "MPLC_TPU_FLEET_STALE_SEC", "MPLC_TPU_FLEET_STATE_DIR",
+          "MPLC_TPU_FLEET_SHARD_ID", "MPLC_TPU_METRICS_TOKEN",
+          "MPLC_TPU_FAULT_PLAN", "MPLC_TPU_MAX_RETRIES",
+          "MPLC_TPU_SEED_ENSEMBLE", "MPLC_TPU_PARTNER_FAULT_PLAN")
+
+
+@pytest.fixture(autouse=True)
+def _router_env(monkeypatch):
+    for k in _KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("MPLC_TPU_RETRY_BACKOFF_SEC", "0")
+    monkeypatch.setenv("MPLC_TPU_COALITIONS_PER_DEVICE", "1")
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def scenario(seed):
+    from helpers import build_scenario
+    return build_scenario(partners_count=P, dataset_name="titanic",
+                          epoch_count=2,
+                          gradient_updates_per_pass_count=2, seed=seed)
+
+
+_REF = {}
+
+
+def solo_values(seed):
+    if seed not in _REF:
+        _REF[seed] = CharacteristicEngine(scenario(seed)).evaluate(SUBSETS)
+    return _REF[seed]
+
+
+def values_of(handle):
+    vals = handle.values()
+    return np.array([vals[s] for s in SUBSETS])
+
+
+def _two_shard_router(tmp_path, slice_coalitions=2, **router_kw):
+    s0 = SweepService(start=False, slice_coalitions=slice_coalitions,
+                      journal_path=str(tmp_path / "s0.wal"))
+    s1 = SweepService(start=False, slice_coalitions=slice_coalitions,
+                      journal_path=str(tmp_path / "s1.wal"))
+    r = FleetRouter(shards={"s0": s0, "s1": s1}, backoff_sec=0.0,
+                    **router_kw)
+    return r, s0, s1
+
+
+# -- fault-plan grammar -------------------------------------------------------
+
+def test_router_fault_plan_grammar():
+    plan = faults.parse_router_fault_plan(
+        "shardkill@shard1:sec5, shardkill@pid_a:sec0.5")
+    assert plan == [
+        {"kind": "shardkill", "shard": "pid_a", "at_sec": 0.5},
+        {"kind": "shardkill", "shard": "shard1", "at_sec": 5.0}]
+    # malformed entries are warn-and-dropped, never fatal
+    with pytest.warns(UserWarning, match="malformed"):
+        plan = faults.parse_router_fault_plan("bogus@x, shardkill@s:sec1")
+    assert plan == [{"kind": "shardkill", "shard": "s", "at_sec": 1.0}]
+    assert faults.parse_router_fault_plan("") == []
+    assert faults.parse_router_fault_plan(None) == []
+
+
+# -- failover ----------------------------------------------------------------
+
+def test_midrun_failover_is_bit_identical_and_journal_seeded(tmp_path):
+    """THE tentpole invariant: kill the accepting shard after one
+    partial quantum — the survivor is seeded from the dead shard's WAL
+    (recovered values > 0: nothing durably harvested retrains) and the
+    final table is bit-identical to the solo fault-free run."""
+    ref = solo_values(7)
+    r, s0, s1 = _two_shard_router(tmp_path,
+                                  journal_path=str(tmp_path / "rt.wal"))
+    h = r.submit(scenario(7), tenant="t0")
+    first = h.shard_id
+    r.pump()                      # partial progress on the first shard
+    assert not h.done
+    r.kill_shard(first)
+    assert h.failed_over
+    assert h.shard_id != first
+    r.run_until_idle(timeout=600)
+    assert h.status == "completed"
+    # the WAL-seeding proof: the survivor's engine was seeded from the
+    # dead shard's journal, not recomputed from scratch
+    assert h._inner.recovered_values >= 1
+    np.testing.assert_array_equal(values_of(h), ref)
+    assert r.stats["failovers"] == 1
+    # the death broke the tenant's pin exactly once, journaled
+    assert r.stats["repins"] == 1
+    records, torn = SweepJournal.replay(str(tmp_path / "rt.wal"))
+    repins = [rec for rec in records if rec.get("type") == "repin"]
+    assert not torn and len(repins) == 1
+    assert repins[0]["reason"] == "death" and repins[0]["from"] == first
+    r.close()
+    s0.shutdown(drain=False)
+    s1.shutdown(drain=False)
+
+
+def test_repin_once_per_death_even_with_multiple_victims(tmp_path):
+    """One kill produces exactly one re-pin per tenant pinned to the
+    corpse (not one per resubmitted job) and every victim completes
+    bit-identically on a survivor."""
+    ref7, ref8 = solo_values(7), solo_values(8)
+    r, s0, s1 = _two_shard_router(tmp_path)
+    ha = r.submit(scenario(7), tenant="A")
+    hb = r.submit(scenario(8), tenant="B", job_id="b1")
+    pins = dict(r._pins)
+    r.pump()
+    victim_shard = ha.shard_id
+    repins_expected = len({t for t, sid in pins.items()
+                           if sid == victim_shard})
+    r.kill_shard(victim_shard)
+    assert r.stats["repins"] == repins_expected
+    r.run_until_idle(timeout=600)
+    assert ha.status == "completed" and hb.status == "completed"
+    np.testing.assert_array_equal(values_of(ha), ref7)
+    np.testing.assert_array_equal(values_of(hb), ref8)
+    r.close()
+    s0.shutdown(drain=False)
+    s1.shutdown(drain=False)
+
+
+def test_all_shards_dead_is_classified_not_hung(tmp_path):
+    """Killing EVERY shard leaves the in-flight job with a classified
+    RoutedJobFailed on its handle — result() raises, nothing hangs."""
+    r, s0, s1 = _two_shard_router(tmp_path)
+    h = r.submit(scenario(7), tenant="t0")
+    r.pump()
+    r.kill_shard("s0")
+    r.kill_shard("s1")
+    assert h.done
+    assert h.status == "failed"
+    with pytest.raises(RoutedJobFailed):
+        h.result(timeout=5)
+    r.close()
+    s0.shutdown(drain=False)
+    s1.shutdown(drain=False)
+
+
+# -- redirect + budget -------------------------------------------------------
+
+def test_budget_exhaustion_is_classified(monkeypatch):
+    """A single overloaded shard and budget=1: the submit fails
+    synchronously with RoutedJobFailed chaining ServiceOverloaded —
+    classified, counted, never silently dropped."""
+    monkeypatch.setenv("MPLC_TPU_SERVICE_RETRY_FLOOR_SEC", "0")
+    svc = SweepService(start=False, max_pending=1, slice_coalitions=1)
+    svc.submit(scenario(7), tenant="filler")      # queue now full
+    r = FleetRouter(shards={"only": svc}, budget=1, backoff_sec=0.0)
+    with trace.collect() as recs:
+        with pytest.raises(RoutedJobFailed) as ei:
+            r.submit(scenario(8), tenant="t0")
+    assert ei.value.attempts == 1
+    assert "ServiceOverloaded" in type(ei.value.__cause__).__name__
+    assert r.stats["budget_exhausted"] == 1
+    names = [rec["name"] for rec in recs]
+    assert "router.exhausted" in names
+    r.close()
+    svc.shutdown(drain=False)
+
+
+def test_redirect_loop_terminates_on_budget(monkeypatch):
+    """Two mutually-overloaded shards: the router bounces between them
+    following redirects but the budget bounds the loop — RoutedJobFailed
+    after exactly `budget` attempts, a redirect event per bounce."""
+    monkeypatch.setenv("MPLC_TPU_SERVICE_RETRY_FLOOR_SEC", "0")
+    s0 = SweepService(start=False, max_pending=1, slice_coalitions=1)
+    s1 = SweepService(start=False, max_pending=1, slice_coalitions=1)
+    s0.submit(scenario(7), tenant="filler")
+    s1.submit(scenario(8), tenant="filler")
+    r = FleetRouter(shards={"s0": s0, "s1": s1}, budget=4,
+                    backoff_sec=0.0)
+    with trace.collect() as recs:
+        with pytest.raises(RoutedJobFailed) as ei:
+            r.submit(scenario(9), tenant="t0")
+    assert ei.value.attempts == 4
+    redirects = [rec for rec in recs if rec["name"] == "router.redirect"]
+    assert len(redirects) == 4
+    assert r.stats["resubmits"] == 4
+    r.close()
+    s0.shutdown(drain=False)
+    s1.shutdown(drain=False)
+
+
+# -- shed coordination + stickiness ------------------------------------------
+
+def test_deferring_shard_is_not_offered_new_work(tmp_path):
+    """Cluster-wide shed coordination: a shard whose admission governor
+    left `healthy` gets no new jobs while a healthy sibling exists —
+    even when the degraded shard has the shallower queue."""
+    s0 = SweepService(start=False, slice_coalitions=2,
+                      shed_p99_sec=0.001)
+    s1 = SweepService(start=False, slice_coalitions=2)
+    r = FleetRouter(shards={"s0": s0, "s1": s1}, backoff_sec=0.0)
+    # trip s0's governor with an ancient queued-age breach
+    assert s0._admission.evaluate([10.0]) == "deferring"
+    h = r.submit(scenario(7), tenant="t0")
+    assert h.shard_id == "s1"
+    r.run_until_idle(timeout=600)
+    assert h.status == "completed"
+    r.close()
+    s0.shutdown(drain=False)
+    s1.shutdown(drain=False)
+
+
+def test_tenant_stickiness_overrides_least_loaded(tmp_path):
+    """A pinned tenant keeps landing on its shard even when the other
+    shard has the shallower queue; a different tenant load-balances."""
+    r, s0, s1 = _two_shard_router(tmp_path)
+    h1 = r.submit(scenario(7), tenant="sticky")
+    pinned = h1.shard_id
+    # the pinned shard now has queue depth 1, the other 0 — least
+    # loaded would pick the other; the pin must win
+    h2 = r.submit(scenario(8), tenant="sticky", job_id="st2")
+    assert h2.shard_id == pinned
+    other = r.submit(scenario(9), tenant="roamer")
+    assert other.shard_id != pinned
+    r.run_until_idle(timeout=600)
+    assert all(h.status == "completed" for h in (h1, h2, other))
+    r.close()
+    s0.shutdown(drain=False)
+    s1.shutdown(drain=False)
+
+
+def test_sustained_overload_breaks_pin_deliberately(tmp_path, monkeypatch):
+    """`repin_overloads` consecutive overloads from the pinned shard
+    break the pin deliberately (reason=overload, journaled); acceptance
+    on the redirect target establishes the new pin."""
+    monkeypatch.setenv("MPLC_TPU_SERVICE_RETRY_FLOOR_SEC", "0")
+    s0 = SweepService(start=False, max_pending=1, slice_coalitions=1)
+    s1 = SweepService(start=False, slice_coalitions=1)
+    r = FleetRouter(shards={"s0": s0, "s1": s1}, budget=8,
+                    backoff_sec=0.0, repin_overloads=1,
+                    journal_path=str(tmp_path / "rt.wal"))
+    # pin the tenant to s0, then fill s0 so its next submit overloads
+    r._pins["t0"] = "s0"
+    s0.submit(scenario(7), tenant="filler")
+    h = r.submit(scenario(8), tenant="t0")   # overload -> break -> s1
+    assert h.shard_id == "s1"
+    assert r.stats["repins"] == 1
+    assert r._pins["t0"] == "s1"             # stickiness follows work
+    records, torn = SweepJournal.replay(str(tmp_path / "rt.wal"))
+    repins = [rec for rec in records if rec.get("type") == "repin"]
+    assert not torn and len(repins) == 1
+    assert repins[0]["reason"] == "overload"
+    assert repins[0]["from"] == "s0" and repins[0]["tenant"] == "t0"
+    r.run_until_idle(timeout=600)
+    assert h.status == "completed"
+    r.close()
+    s0.shutdown(drain=False)
+    s1.shutdown(drain=False)
+
+
+# -- authenticated submit path (satellite) -----------------------------------
+
+def test_submit_auth_master_and_tenant_token(monkeypatch):
+    monkeypatch.setenv("MPLC_TPU_METRICS_TOKEN", "hunter2")
+    svc = SweepService(start=False, slice_coalitions=4)
+    # the in-process embedder stays trusted: no credential, no check
+    ok0 = svc.submit(scenario(7), tenant="A")
+    # the master token and the tenant-scoped HMAC both pass
+    ok1 = svc.submit(scenario(8), tenant="A", credential="hunter2",
+                     job_id="j1")
+    ok2 = svc.submit(scenario(9), tenant="B",
+                     credential=obs_export.tenant_token("hunter2", "B"),
+                     job_id="j2")
+    # a wrong credential (or another tenant's token) fails SYNCHRONOUSLY
+    with pytest.raises(ServiceAuthError):
+        svc.submit(scenario(10), tenant="B", credential="wrong")
+    with pytest.raises(ServiceAuthError):
+        svc.submit(scenario(10), tenant="B",
+                   credential=obs_export.tenant_token("hunter2", "A"))
+    assert metrics.snapshot()["counters"].get(
+        "service.auth_rejected") == 2
+    svc.run_until_idle()
+    assert all(j.status == "completed" for j in (ok0, ok1, ok2))
+    svc.shutdown(drain=False)
+
+
+def test_wire_submit_requires_credential_when_token_set(monkeypatch):
+    """The trust model's wire half: ShardServer (the HTTP surface)
+    REQUIRES a credential when the token is set — the in-process
+    trusted-embedder bypass must not extend over the network."""
+    monkeypatch.setenv("MPLC_TPU_METRICS_TOKEN", "hunter2")
+    svc = SweepService(start=False, slice_coalitions=4)
+    srv = ShardServer(svc, lambda spec: scenario(7))
+    with pytest.raises(ServiceAuthError):
+        srv.handle("submit", {"tenant": "A"})
+    ack = srv.handle("submit", {"tenant": "A", "credential": "hunter2"})
+    assert ack["tenant"] == "A"
+    svc.run_until_idle()
+    srv.close()
+    svc.shutdown(drain=False)
+
+
+# -- cluster_view staleness (satellite) --------------------------------------
+
+def test_cluster_view_excludes_stale_and_closed_from_least_loaded(tmp_path):
+    """A dead shard's last published queue depth was probably 0 —
+    exactly the bait a naive least-loaded rule would take. Stale and
+    closed shards are flagged, kept as evidence, and never recommended."""
+    d = str(tmp_path)
+    fleet.publish_shard_state(d, "dead", {"queue_depth": 0})
+    fleet.publish_shard_state(d, "closing", {"queue_depth": 0,
+                                             "closed": True})
+    fleet.publish_shard_state(d, "busy", {"queue_depth": 9})
+    # age the dead shard's state file past the window
+    path = os.path.join(d, "shard_dead.json")
+    with open(path) as f:
+        doc = json.load(f)
+    doc["ts"] = time.time() - 100.0
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    view = fleet.cluster_view(d, stale_sec=30.0)
+    assert view["shards"]["dead"]["stale"]
+    assert view["live_shards"] == 1 and view["stale_shards"] == 1
+    assert view["least_loaded"] == "busy"
+    # the env knob retunes the window (satellite: MPLC_TPU_FLEET_STALE_SEC)
+    os.environ["MPLC_TPU_FLEET_STALE_SEC"] = "1000"
+    try:
+        view = fleet.cluster_view(d)
+        assert not view["shards"]["dead"]["stale"]
+        assert view["least_loaded"] == "dead"
+    finally:
+        del os.environ["MPLC_TPU_FLEET_STALE_SEC"]
+
+
+def test_shutdown_publishes_closed_state_immediately(tmp_path, monkeypatch):
+    """A shutting-down shard publishes `closed: true` so routers stop
+    offering it work — cluster_view never recommends it again."""
+    monkeypatch.setenv("MPLC_TPU_FLEET_STATE_DIR", str(tmp_path))
+    monkeypatch.setenv("MPLC_TPU_FLEET_SHARD_ID", "sX")
+    svc = SweepService(start=False, slice_coalitions=4)
+    svc.shutdown(drain=False)
+    view = fleet.cluster_view(str(tmp_path))
+    assert view["shards"]["sX"]["closed"]
+    assert view["least_loaded"] is None
+
+
+# -- observability ------------------------------------------------------------
+
+def test_router_report_row_and_varz(tmp_path):
+    from mplc_tpu.obs.report import format_report, sweep_report
+    ref = solo_values(7)
+    r, s0, s1 = _two_shard_router(tmp_path)
+    with trace.collect() as recs:
+        h = r.submit(scenario(7), tenant="t0")
+        r.pump()
+        r.kill_shard(h.shard_id)
+        r.run_until_idle(timeout=600)
+    np.testing.assert_array_equal(values_of(h), ref)
+    rep = sweep_report(recs)
+    row = rep["router"]
+    assert row["routed"] == 1 and row["failovers"] == 1
+    assert row["repins"] == 1 and row["failover_jobs"] == 1
+    assert row["route_s"]["p50"] is not None
+    assert "  router " in format_report(rep)
+    vz = r.varz_view()
+    assert vz["jobs"][h.job_id]["failed_over"]
+    assert set(vz["table"]) == {"s0", "s1"}
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("router.jobs_routed") == 1
+    assert counters.get("router.failovers") == 1
+    r.close()
+    s0.shutdown(drain=False)
+    s1.shutdown(drain=False)
+
+
+def test_router_fault_plan_drives_kill(tmp_path):
+    """The chaos grammar end-to-end: a shardkill entry at sec0 fires on
+    the first refresh, kills the named shard (`shard0` = insertion
+    order), and the job completes bit-identically elsewhere."""
+    ref = solo_values(7)
+    s0 = SweepService(start=False, slice_coalitions=2)
+    s1 = SweepService(start=False, slice_coalitions=2)
+    r = FleetRouter(shards={"s0": s0, "s1": s1}, backoff_sec=0.0,
+                    fault_plan="shardkill@shard0:sec0")
+    with trace.collect() as recs:
+        h = r.submit(scenario(7), tenant="t0")
+        r.run_until_idle(timeout=600)
+    assert [rec for rec in recs if rec["name"] == "router.fault"]
+    assert r._shards["s0"].dead
+    assert h.status == "completed" and h.shard_id == "s1"
+    np.testing.assert_array_equal(values_of(h), ref)
+    r.close()
+    s0.shutdown(drain=False)
+    s1.shutdown(drain=False)
+
+
+# -- bench + load_gen wiring (satellite) --------------------------------------
+
+def test_bench11_dispatches_to_router():
+    import importlib
+    import inspect
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    bench = importlib.import_module("bench")
+    assert hasattr(bench, "bench_router")
+    src = inspect.getsource(bench.main)
+    assert 'config == "11"' in src and "bench_router" in src
+    # the router knobs are workload-shaping: the bench knob list
+    # carries every one of them
+    for knob in ("MPLC_TPU_ROUTER_BUDGET", "MPLC_TPU_ROUTER_BACKOFF_SEC",
+                 "MPLC_TPU_ROUTER_REPIN_OVERLOADS",
+                 "MPLC_TPU_ROUTER_FAULT_PLAN", "MPLC_TPU_ROUTER_SERVE",
+                 "MPLC_TPU_FLEET_STALE_SEC",
+                 "MPLC_TPU_SERVICE_RETRY_FLOOR_SEC"):
+        assert knob in bench._WORKLOAD_KNOBS
+
+
+def test_load_gen_router_mode_wired():
+    import importlib
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "scripts"))
+    load_gen = importlib.import_module("load_gen")
+    assert hasattr(load_gen, "run_router")
+    assert hasattr(load_gen, "run_router_shard")
+    sc = load_gen.scenario_from_spec({"partners": 2, "seed": 3})
+    assert sc.partners_count == 2
+
+
+# -- InProcShard surface ------------------------------------------------------
+
+def test_inproc_shard_adoption_is_idempotent():
+    """A failover resubmission that bounces (overload) and retries must
+    re-adopt the recovered seed without error — the seed values are
+    identical by construction, adoption is idempotent."""
+    svc = SweepService(start=False, slice_coalitions=4)
+    shard = InProcShard("s", svc)
+    req = {"scenario": scenario(7), "method": "Shapley values",
+           "tenant": "t0", "job_id": "jX", "deadline_sec": None,
+           "priority": None, "credential": None}
+    recover = {"values": {(1,): 0.5}, "partners_count": P}
+    shard._adopt(recover, req)
+    shard._adopt(recover, req)          # idempotent re-adoption
+    assert svc._jobs.get("jX") is None  # adoption alone submits nothing
+    shard.submit(req, recover=recover)
+    svc.run_until_idle()
+    job = svc._jobs["jX"]
+    assert job.status == "completed"
+    assert job.recovered_values == 1
+    svc.shutdown(drain=False)
