@@ -92,12 +92,18 @@ from .scheduler import (JobShed, ServiceAuthError, ServiceClosed,
 logger = logging.getLogger("mplc_tpu")
 
 # capped exponential backoff: attempt k sleeps
-# max(retry_after hint, base * 2^(k-1)) bounded at base * _BACKOFF_CAP_MULT
+# max(retry_after hint, min(base * 2^(k-1), base * _BACKOFF_CAP_MULT)) —
+# the cap bounds the router's OWN exponential term; a shard's explicit
+# retry_after_sec hint is always honored in full
 _BACKOFF_CAP_MULT = 32.0
 # liveness probes (healthz / cluster view) are rate-limited per shard so
 # a tight routing loop never turns into a tight HTTP/stat loop
 _PROBE_INTERVAL_SEC = 0.5
 _HTTP_TIMEOUT_SEC = 10.0
+# terminal routed jobs are archived as small summaries (shard/status/
+# attempts) rather than kept as full records — a long-lived router must
+# not leak one req+handle per job forever. /varz shows the most recent:
+_DONE_JOBS_KEEP = 256
 
 
 class RoutedJobFailed(ServiceError):
@@ -132,7 +138,10 @@ class InProcShard:
     deterministic harness the chaos tests and BENCH_CONFIG=11 drive.
     `kill()` ABANDONS the service (no shutdown, no journal close): the
     WAL on disk is exactly what a SIGKILL would leave, which is what
-    failover replays."""
+    failover replays. A threaded (start=True) service additionally has
+    its worker pool stopped at the next quantum boundary
+    (`SweepService.abandon`) — a "dead" shard must not keep executing
+    the jobs failover resubmits to survivors."""
 
     kind = "inproc"
 
@@ -169,16 +178,13 @@ class InProcShard:
             credential=req.get("credential"))
 
     def _adopt(self, recover: dict, req: dict) -> None:
-        try:
-            self.service.adopt_recovered(
-                req["job_id"], tenant=req["tenant"], method=req["method"],
-                partners_count=recover.get("partners_count"),
-                values=recover.get("values") or {})
-        except ValueError:
-            # an earlier routing attempt already adopted these values on
-            # this shard (then hit backpressure): the seed is identical,
-            # adoption is idempotent by construction
-            pass
+        # re-adoption of the SAME seed on a routing retry is idempotent
+        # inside adopt_recovered; a differing seed raises — a real bug,
+        # never swallowed (it would silently break bit-identity)
+        self.service.adopt_recovered(
+            req["job_id"], tenant=req["tenant"], method=req["method"],
+            partners_count=recover.get("partners_count"),
+            values=recover.get("values") or {})
 
     def job_status(self, job_id: str) -> dict:
         job = self.service._jobs.get(job_id)
@@ -188,6 +194,14 @@ class InProcShard:
 
     def kill(self) -> None:
         self.dead = True
+        if self.service._workers:
+            # a threaded (start=True) service's workers would otherwise
+            # keep executing the very jobs failover is about to resubmit
+            # to survivors — duplicate execution, double device-second
+            # metering. abandon() stops them at the next quantum
+            # boundary without draining, cancelling or closing the
+            # journal, so the WAL stays SIGKILL-shaped for the replay.
+            self.service.abandon()
 
     def pump(self) -> bool:
         """Advance an inline (start=False) service one scheduling
@@ -506,6 +520,7 @@ class FleetRouter:
         self._pins: dict = {}            # tenant -> shard_id
         self._pin_overloads: dict = {}   # tenant -> consecutive overloads
         self._routed: dict = {}          # job_id -> {"req", "shard", "handle"}
+        self._done_jobs: dict = {}       # job_id -> summary (bounded archive)
         self._next_id = 0
         self._last_view_ts = 0.0
         self._t0 = time.monotonic()
@@ -598,7 +613,20 @@ class FleetRouter:
         """One liveness pass: fire due chaos entries, fold the published
         cluster view into the table (HTTP discovery + admission states),
         and failover any shard newly found dead (stale state / 503 /
-        unreachable)."""
+        unreachable). Also retires terminal routed jobs: their full
+        records (req + handle) are dropped and a small summary is
+        archived for /varz — a long-lived router stays O(in-flight),
+        not O(every job ever routed)."""
+        with self._lock:
+            for jid in [j for j, rec in self._routed.items()
+                        if rec["handle"].done]:
+                h = self._routed.pop(jid)["handle"]
+                self._done_jobs[jid] = {
+                    "shard": h.shard_id, "status": h.status,
+                    "attempts": h.attempts,
+                    "failed_over": h.failed_over}
+            while len(self._done_jobs) > _DONE_JOBS_KEEP:
+                del self._done_jobs[next(iter(self._done_jobs))]
         self._poll_faults()
         view = None
         if self._state_dir:
@@ -667,7 +695,8 @@ class FleetRouter:
         if not cands:
             return None
         by_id = {s.shard_id: s for s in cands}
-        pin = self._pins.get(tenant)
+        with self._lock:
+            pin = self._pins.get(tenant)
         if pin in by_id:
             return by_id[pin]
         if prefer in by_id:
@@ -678,11 +707,12 @@ class FleetRouter:
 
     def _break_pin(self, tenant: str, reason: str,
                    to: "str | None" = None) -> None:
-        old = self._pins.pop(tenant, None)
-        if old is None:
-            return
-        self._pin_overloads.pop(tenant, None)
-        self.stats["repins"] += 1
+        with self._lock:
+            old = self._pins.pop(tenant, None)
+            if old is None:
+                return
+            self._pin_overloads.pop(tenant, None)
+            self.stats["repins"] += 1
         obs_metrics.counter("router.repins").inc()
         obs_trace.event("router.repin", tenant=tenant, **{"from": old},
                         to=to, reason=reason)
@@ -695,14 +725,18 @@ class FleetRouter:
                 logger.warning("router: could not journal re-pin: %s", e)
 
     def _note_overload(self, tenant: str, shard_id: str) -> None:
-        if self._pins.get(tenant) != shard_id:
-            return
-        n = self._pin_overloads.get(tenant, 0) + 1
-        self._pin_overloads[tenant] = n
-        if n >= self._repin_overloads:
-            # sustained overload from the pinned shard: a deliberate
-            # re-pin (the new pin lands on the next accepted submit)
-            self._break_pin(tenant, reason="overload")
+        # the count-then-break must be one atomic step: a concurrent
+        # _accept (re-pin to a fresh shard, counter reset) between the
+        # increment and the break would otherwise get its new pin broken
+        with self._lock:
+            if self._pins.get(tenant) != shard_id:
+                return
+            n = self._pin_overloads.get(tenant, 0) + 1
+            self._pin_overloads[tenant] = n
+            if n >= self._repin_overloads:
+                # sustained overload from the pinned shard: a deliberate
+                # re-pin (the new pin lands on the next accepted submit)
+                self._break_pin(tenant, reason="overload")
 
     # -- submission ------------------------------------------------------
 
@@ -725,7 +759,9 @@ class FleetRouter:
             self._next_id += 1
             if job_id is None:
                 job_id = f"rt{self._next_id}"
-            if job_id in self._routed:
+            if job_id in self._routed or job_id in self._done_jobs:
+                # terminal jobs older than the _DONE_JOBS_KEEP archive
+                # window are forgotten — their ids become reusable
                 raise ValueError(
                     f"job id {job_id!r} already routed by this router")
         if credential is None:
@@ -844,8 +880,9 @@ class FleetRouter:
         retry_after hint; in-proc inline shards are pumped while the
         router waits, so the very backpressure being backed off from is
         actually draining."""
-        delay = min(max(hint, self._backoff * (2.0 ** (attempt - 1))),
-                    self._backoff * _BACKOFF_CAP_MULT)
+        delay = max(hint,
+                    min(self._backoff * (2.0 ** (attempt - 1)),
+                        self._backoff * _BACKOFF_CAP_MULT))
         deadline = time.monotonic() + delay
         while True:
             worked = False
@@ -894,7 +931,10 @@ class FleetRouter:
             # the re-pin: break the dead pin BEFORE routing so the pick
             # lands on a survivor; the accept below establishes the new
             # pin — exactly one repin per (tenant, death)
-            if self._pins.get(req["tenant"]) == shard.shard_id:
+            with self._lock:
+                pinned_to_corpse = (
+                    self._pins.get(req["tenant"]) == shard.shard_id)
+            if pinned_to_corpse:
                 self._break_pin(req["tenant"], reason="death")
             try:
                 inner, new_shard, attempts = self._route(
@@ -1000,16 +1040,19 @@ class FleetRouter:
     def varz_view(self) -> dict:
         """The /varz `router_*` block: the live routing table, sticky
         pins and routing totals — what an operator reads to see WHERE
-        the fleet's work is going and which shards are drained."""
+        the fleet's work is going and which shards are drained. `jobs`
+        carries every in-flight job plus the last `_DONE_JOBS_KEEP`
+        terminal ones (older terminals live on only in the totals)."""
         with self._lock:
             table = {sid: s.describe()
                      for sid, s in self._shards.items()}
             pins = dict(self._pins)
-            jobs = {jid: {"shard": r["shard"],
-                          "status": r["handle"].status,
-                          "attempts": r["handle"].attempts,
-                          "failed_over": r["handle"].failed_over}
-                    for jid, r in self._routed.items()}
+            jobs = {jid: dict(s) for jid, s in self._done_jobs.items()}
+            jobs.update({jid: {"shard": r["shard"],
+                               "status": r["handle"].status,
+                               "attempts": r["handle"].attempts,
+                               "failed_over": r["handle"].failed_over}
+                         for jid, r in self._routed.items()})
         return {"budget": self._budget,
                 "backoff_sec": self._backoff,
                 "repin_overloads": self._repin_overloads,
@@ -1029,8 +1072,10 @@ class ShardServer:
     `MPLC_TPU_METRICS_PORT`), rebuilds each wire spec into a real
     `Scenario` via the injected `scenario_builder(spec)`, and enforces
     the wire's auth rule: when `MPLC_TPU_METRICS_TOKEN` is set a routed
-    submission MUST carry a credential (the in-process embedder is
-    trusted; the network authenticates)."""
+    submission MUST carry a credential, and the credential is validated
+    BEFORE any state mutation — recover-payload adoption and scenario
+    building happen on the far side of the auth check (the in-process
+    embedder is trusted; the network authenticates)."""
 
     def __init__(self, service, scenario_builder):
         self.service = service
@@ -1057,6 +1102,11 @@ class ShardServer:
                 "the routed submit surface requires a credential when "
                 f"{constants.METRICS_TOKEN_ENV} is set (the master "
                 "token, or tenant_token(master, tenant))")
+        # authenticate BEFORE touching any service state: an invalid
+        # wire caller must not get to install recover values (or spend
+        # scenario_builder work) on its way to the 403 — a rejected
+        # submission leaves the service exactly as it found it
+        self.service._check_credential(tenant, credential)
         job_id = doc.get("job_id")
         recover = doc.get("recover")
         if recover is not None:
@@ -1065,15 +1115,13 @@ class ShardServer:
                                  "original job_id")
             values = {tuple(int(i) for i in s): float(v)
                       for s, v in (recover.get("values") or [])}
-            try:
-                self.service.adopt_recovered(
-                    job_id, tenant=tenant, method=doc.get("method"),
-                    partners_count=recover.get("partners_count"),
-                    values=values)
-            except ValueError:
-                # idempotent re-adoption on a routing retry (the seed
-                # values are identical by construction)
-                pass
+            # re-adoption of an identical seed on a routing retry is
+            # idempotent inside adopt_recovered; a DIFFERING seed for a
+            # known job raises (400 on the wire) — never silently kept
+            self.service.adopt_recovered(
+                job_id, tenant=tenant, method=doc.get("method"),
+                partners_count=recover.get("partners_count"),
+                values=values)
         scenario = self.scenario_builder(doc.get("spec") or {})
         job = self.service.submit(
             scenario, method=doc.get("method") or "Shapley values",

@@ -488,6 +488,7 @@ class SweepService:
         self._jobs: dict = {}
         self._ordinal = 0
         self._closed = False
+        self._abandoned = False  # abandon(): SIGKILL-shaped worker stop
         self._running_job = None
         self._worker = None
         self._workers: list = []
@@ -819,8 +820,13 @@ class SweepService:
         these values exactly as it would from this shard's own journal,
         so the continuation is bit-identical to a solo fault-free run
         and only never-harvested coalitions train. Refuses a `job_id`
-        already known to this service (live or recovered — adopting
-        over either would mix two games' v(S) tables)."""
+        already known to this service (live, or recovered with a
+        DIFFERENT seed — adopting over either would mix two games' v(S)
+        tables); re-adopting the exact same seed is idempotent, so a
+        routing retry that already adopted here (then hit backpressure)
+        is a no-op rather than an error."""
+        norm = {tuple(s): float(v) for s, v in (values or {}).items()}
+        pc = int(partners_count) if partners_count is not None else None
         with self._lock:
             if self._closed:
                 raise ServiceClosed("service is shut down")
@@ -829,18 +835,22 @@ class SweepService:
                     f"job id {job_id!r} is already live on this service "
                     "— cannot adopt a foreign journal's values for it")
             if job_id in self._recovered:
+                slot = self._recovered[job_id]
+                if (not slot["done"] and slot["values"] == norm
+                        and (pc is None
+                             or slot.get("partners_count") is None
+                             or pc == slot["partners_count"])):
+                    return  # identical seed: idempotent re-adoption
                 raise ValueError(
                     f"job id {job_id!r} already has recovered state on "
-                    "this service — refusing to overwrite it with a "
-                    "foreign journal's")
+                    "this service that differs from the adoption "
+                    "payload — refusing to overwrite it with a foreign "
+                    "journal's")
             self._recovered[job_id] = {
-                "values": {tuple(s): float(v)
-                           for s, v in (values or {}).items()},
+                "values": norm,
                 "done": False, "quarantined": False, "cancelled": False,
                 "shed": False, "tenant": tenant, "method": method,
-                "partners_count": (int(partners_count)
-                                   if partners_count is not None
-                                   else None)}
+                "partners_count": pc}
 
     # -- submission ------------------------------------------------------
 
@@ -1280,6 +1290,10 @@ class SweepService:
     def _worker_loop(self, worker: "_WorkerSlot") -> None:
         while True:
             with self._lock:
+                if self._abandoned:
+                    # abandon(): stop at the quantum boundary, leaving
+                    # queue/jobs/journal exactly as a SIGKILL would
+                    return
                 victims, job = self._pick_locked()
                 while job is None and not victims and not self._closed:
                     # in a fleet deployment the idle wait is BOUNDED so an
@@ -1359,27 +1373,55 @@ class SweepService:
                     raise TimeoutError("service did not drain in time")
                 self._lock.wait(wait)
 
+    def abandon(self, timeout: "float | None" = 5.0) -> None:
+        """Chaos/test hook: die like a SIGKILL, minus the threads. Stops
+        the worker pool at the next quantum boundary WITHOUT draining,
+        cancelling, publishing or closing the journal — queued and
+        running jobs stay non-terminal and the WAL on disk is exactly
+        what a process death would leave, which is what a fleet router's
+        failover replays. The currently-running quantum cannot be
+        preempted (it finishes, journaling its harvest — deterministic,
+        so a survivor's re-run of it is bit-identical); `timeout` bounds
+        the per-thread join. Idempotent; a no-op for inline
+        (start=False) services, which have no threads to stop."""
+        with self._lock:
+            self._abandoned = True
+            self._closed = True
+            self._lock.notify_all()
+        for w in self._workers:
+            if w.thread is not None \
+                    and w.thread is not threading.current_thread():
+                w.thread.join(timeout)
+        self._workers = []
+        self._worker = None
+
     def shutdown(self, drain: bool = True,
                  timeout: "float | None" = None) -> None:
         """Stop accepting submissions; with `drain` (the default) finish
         every queued job first, otherwise cancel whatever never started.
-        Idempotent; closes the journal last."""
+        Idempotent; closes the journal last. After `abandon()` the
+        service is already dead: shutdown only releases resources —
+        no draining, no cancel records, no state publishing (the corpse
+        must not journal or heartbeat post-mortem)."""
         with self._lock:
+            abandoned = self._abandoned
             self._closed = True
-            if not drain:
+            if not drain and not abandoned:
                 while len(self._queue):
                     job = self._queue.pop()
                     self._terminal(job, "cancelled",
                                    JobCancelled("service shutdown"))
             self._lock.notify_all()
-        # force-publish the `closed: true` state BEFORE draining: without
-        # this a cleanly shut-down shard keeps its last (healthy,
-        # queue_depth 0) state file for up to the staleness bound and the
-        # cluster view recommends a corpse as "least loaded" — exactly
-        # the redirect a router must never follow
-        self._publish_fleet_state(force=True)
-        if drain:
-            self.drain(timeout)
+        if not abandoned:
+            # force-publish the `closed: true` state BEFORE draining:
+            # without this a cleanly shut-down shard keeps its last
+            # (healthy, queue_depth 0) state file for up to the
+            # staleness bound and the cluster view recommends a corpse
+            # as "least loaded" — exactly the redirect a router must
+            # never follow
+            self._publish_fleet_state(force=True)
+            if drain:
+                self.drain(timeout)
         for w in self._workers:
             if w.thread is not None:
                 w.thread.join(timeout)

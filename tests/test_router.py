@@ -342,6 +342,58 @@ def test_wire_submit_requires_credential_when_token_set(monkeypatch):
     svc.shutdown(drain=False)
 
 
+def test_wire_submit_rejected_credential_mutates_nothing(monkeypatch):
+    """An INVALID credential must be rejected BEFORE any state
+    mutation: the 403 installs no recover values into the service's
+    recovered table and never even builds the scenario — a wire
+    attacker cannot pre-seed a future failover's v(S) values under an
+    arbitrary job_id on its way to the auth error."""
+    monkeypatch.setenv("MPLC_TPU_METRICS_TOKEN", "hunter2")
+    svc = SweepService(start=False, slice_coalitions=4)
+    built = []
+    srv = ShardServer(svc, lambda spec: built.append(spec) or scenario(7))
+    evil = {"tenant": "A", "credential": "wrong", "job_id": "poisoned",
+            "recover": {"partners_count": P,
+                        "values": [[[0], 666.0], [[1], 666.0]]}}
+    with pytest.raises(ServiceAuthError):
+        srv.handle("submit", evil)
+    # another tenant's valid token must not authenticate tenant A either
+    evil["credential"] = obs_export.tenant_token("hunter2", "B")
+    with pytest.raises(ServiceAuthError):
+        srv.handle("submit", evil)
+    assert "poisoned" not in svc._recovered   # nothing was installed
+    assert built == []                        # no scenario work spent
+    # a legitimate later adoption of the same job id starts clean
+    svc.adopt_recovered("poisoned", tenant="A", partners_count=P,
+                        values={(0,): 0.25})
+    assert svc._recovered["poisoned"]["values"] == {(0,): 0.25}
+    srv.close()
+    svc.shutdown(drain=False)
+
+
+def test_adopt_recovered_refuses_differing_seed():
+    """Re-adoption is idempotent ONLY for an identical seed; a
+    differing seed for a known job raises instead of being silently
+    swallowed — silent divergence here would break the bit-identity
+    failover contract."""
+    svc = SweepService(start=False, slice_coalitions=4)
+    shard = InProcShard("s", svc)
+    req = {"scenario": scenario(7), "method": "Shapley values",
+           "tenant": "t0", "job_id": "jD", "deadline_sec": None,
+           "priority": None, "credential": None}
+    shard._adopt({"values": {(1,): 0.5}, "partners_count": P}, req)
+    # identical seed: no-op
+    shard._adopt({"values": {(1,): 0.5}, "partners_count": P}, req)
+    # differing seed: refused loudly
+    with pytest.raises(ValueError, match="differs"):
+        shard._adopt({"values": {(1,): 0.75}, "partners_count": P}, req)
+    with pytest.raises(ValueError, match="differs"):
+        shard._adopt({"values": {(1,): 0.5}, "partners_count": P + 1},
+                     req)
+    assert svc._recovered["jD"]["values"] == {(1,): 0.5}
+    svc.shutdown(drain=False)
+
+
 # -- cluster_view staleness (satellite) --------------------------------------
 
 def test_cluster_view_excludes_stale_and_closed_from_least_loaded(tmp_path):
@@ -491,3 +543,68 @@ def test_inproc_shard_adoption_is_idempotent():
     assert job.status == "completed"
     assert job.recovered_values == 1
     svc.shutdown(drain=False)
+
+
+def test_backoff_honors_hint_beyond_cap():
+    """The 32× cap bounds the router's OWN exponential term, never the
+    shard's explicit retry_after_sec hint — retrying sooner than the
+    shard asked would defeat the hint's whole purpose."""
+    r = FleetRouter(shards={}, backoff_sec=0.0)
+    # base 0.0: exponential term and cap are both 0 — only the hint
+    # can make the router wait, and it must be honored in full
+    t0 = time.monotonic()
+    r._backoff_wait(0.12, attempt=1)
+    assert time.monotonic() - t0 >= 0.12
+    r.close()
+
+
+def test_terminal_jobs_pruned_into_bounded_varz_archive(tmp_path):
+    """A long-lived router must not leak one req+handle per job: the
+    refresh retires terminal routed jobs to a small summary archive —
+    /varz still shows them, pump/failover no longer iterate them, and
+    their ids stay reserved while archived."""
+    r, s0, s1 = _two_shard_router(tmp_path)
+    h = r.submit(scenario(7), tenant="t0", job_id="jP")
+    assert "jP" in r._routed
+    r.run_until_idle(timeout=600)
+    assert h.status == "completed"
+    r._refresh()
+    assert "jP" not in r._routed          # full record dropped
+    vz = r.varz_view()
+    assert vz["jobs"]["jP"]["status"] == "completed"
+    assert vz["jobs"]["jP"]["shard"] == h.shard_id
+    with pytest.raises(ValueError, match="already routed"):
+        r.submit(scenario(8), tenant="t0", job_id="jP")
+    r.close()
+    s0.shutdown(drain=False)
+    s1.shutdown(drain=False)
+
+
+def test_threaded_shard_kill_stops_workers_before_failover(tmp_path):
+    """Killing a THREADED (start=True) in-proc shard stops its worker
+    pool at the quantum boundary before failover resubmits its jobs —
+    otherwise the 'dead' shard would keep executing the same jobs a
+    survivor re-runs (duplicate execution, double metering). The
+    journal stays SIGKILL-shaped and the failed-over result is
+    bit-identical to a solo fault-free run."""
+    ref = solo_values(7)
+    s0 = SweepService(start=True, workers=1, slice_coalitions=1,
+                      journal_path=str(tmp_path / "s0.wal"))
+    s1 = SweepService(start=False, slice_coalitions=2,
+                      journal_path=str(tmp_path / "s1.wal"))
+    r = FleetRouter(shards={"s0": s0, "s1": s1}, backoff_sec=0.0)
+    r._pins["t0"] = "s0"                  # force the threaded shard
+    h = r.submit(scenario(7), tenant="t0")
+    assert h.shard_id == "s0"
+    r.kill_shard("s0")
+    # the pool is stopped: no thread left to keep executing the corpse's
+    # jobs while the survivor re-runs them
+    assert s0._abandoned and s0._workers == []
+    if not h.done:                        # completed-before-kill is fine
+        assert h.failed_over and h.shard_id == "s1"
+    r.run_until_idle(timeout=600)
+    assert h.status == "completed"
+    np.testing.assert_array_equal(values_of(h), ref)
+    r.close()
+    s0.shutdown(drain=False)
+    s1.shutdown(drain=False)
